@@ -1,0 +1,209 @@
+"""Aggregator tests, mirroring reference test/learning/aggregator_test.py
+(numeric FedAvg checks, lifecycle/locking) and scaffold_test.py (math vs
+hand-computed expectations, missing-info errors)."""
+
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpfl.learning.aggregators import (
+    FedAvg,
+    FedMedian,
+    FedProx,
+    Krum,
+    MultiKrum,
+    Scaffold,
+    TrimmedMean,
+)
+from tpfl.learning.aggregators.aggregator import NoModelsToAggregateError
+from tpfl.learning.model import TpflModel
+
+
+def mk_model(value, n_samples, contributors, extra=None):
+    params = {
+        "w": jnp.full((2, 2), float(value), jnp.float32),
+        "b": jnp.full((2,), float(value), jnp.float32),
+    }
+    m = TpflModel(params=params, num_samples=n_samples, contributors=contributors)
+    if extra:
+        m.additional_info.update(extra)
+    return m
+
+
+# --- FedAvg math (reference aggregator_test.py simple + weighted cases) ---
+
+
+def test_fedavg_simple_mean():
+    agg = FedAvg("t")
+    out = agg.aggregate([mk_model(1, 1, ["a"]), mk_model(3, 1, ["b"])])
+    np.testing.assert_allclose(np.asarray(out.get_parameters()["w"]), 2.0)
+    assert out.get_contributors() == ["a", "b"]
+    assert out.get_num_samples() == 2
+
+
+def test_fedavg_weighted_mean():
+    agg = FedAvg("t")
+    out = agg.aggregate([mk_model(0, 1, ["a"]), mk_model(4, 3, ["b"])])
+    np.testing.assert_allclose(np.asarray(out.get_parameters()["w"]), 3.0)
+
+
+def test_fedmedian():
+    agg = FedMedian("t")
+    out = agg.aggregate(
+        [mk_model(0, 1, ["a"]), mk_model(1, 1, ["b"]), mk_model(100, 1, ["c"])]
+    )
+    np.testing.assert_allclose(np.asarray(out.get_parameters()["w"]), 1.0)
+
+
+def test_trimmed_mean_robust_to_outlier():
+    agg = TrimmedMean("t", trim=1)
+    out = agg.aggregate(
+        [mk_model(0, 1, ["a"]), mk_model(1, 1, ["b"]), mk_model(2, 1, ["c"]),
+         mk_model(1000, 1, ["d"])]
+    )
+    np.testing.assert_allclose(np.asarray(out.get_parameters()["w"]), 1.5)
+
+
+def test_krum_picks_cluster_member():
+    agg = Krum("t", n_byzantine=1)
+    out = agg.aggregate(
+        [mk_model(1.0, 1, ["a"]), mk_model(1.1, 1, ["b"]),
+         mk_model(0.9, 1, ["c"]), mk_model(50.0, 1, ["evil"])]
+    )
+    assert float(np.asarray(out.get_parameters()["w"])[0, 0]) < 2.0
+
+
+def test_multikrum_averages_best():
+    agg = MultiKrum("t", n_byzantine=1, m=2)
+    out = agg.aggregate(
+        [mk_model(1.0, 1, ["a"]), mk_model(1.0, 1, ["b"]),
+         mk_model(1.0, 1, ["c"]), mk_model(-99.0, 1, ["evil"])]
+    )
+    np.testing.assert_allclose(np.asarray(out.get_parameters()["w"]), 1.0)
+
+
+# --- lifecycle / state machine (reference aggregator_test.py:116+) ---
+
+
+def test_aggregator_lifecycle_and_finish_event():
+    agg = FedAvg("t")
+    agg.set_nodes_to_aggregate(["a", "b"])
+    assert agg.get_missing_models() == {"a", "b"}
+    assert agg.add_model(mk_model(1, 1, ["a"])) == ["a"]
+    assert not agg._finish_aggregation_event.is_set()
+    assert agg.add_model(mk_model(3, 1, ["b"])) == ["a", "b"]
+    assert agg._finish_aggregation_event.is_set()
+    out = agg.wait_and_get_aggregation(timeout=1)
+    np.testing.assert_allclose(np.asarray(out.get_parameters()["w"]), 2.0)
+    agg.clear()
+    assert agg.get_aggregated_models() == []
+
+
+def test_aggregator_rejects_bad_contributions():
+    agg = FedAvg("t")
+    agg.set_nodes_to_aggregate(["a", "b"])
+    # not in train set
+    assert agg.add_model(mk_model(1, 1, ["z"])) == []
+    # ok
+    assert agg.add_model(mk_model(1, 1, ["a"])) == ["a"]
+    # duplicate
+    assert agg.add_model(mk_model(2, 1, ["a"])) == []
+    # overlapping partial
+    assert agg.add_model(mk_model(2, 1, ["a", "b"])) == []
+
+
+def test_aggregator_timeout_partial_and_empty():
+    agg = FedAvg("t")
+    agg.set_nodes_to_aggregate(["a", "b"])
+    agg.add_model(mk_model(5, 1, ["a"]))
+    out = agg.wait_and_get_aggregation(timeout=0.1)  # b missing -> partial
+    np.testing.assert_allclose(np.asarray(out.get_parameters()["w"]), 5.0)
+    agg.clear()
+    agg.set_nodes_to_aggregate(["a"])
+    with pytest.raises(NoModelsToAggregateError):
+        agg.wait_and_get_aggregation(timeout=0.1)
+    agg.clear()
+
+
+def test_aggregator_double_start_raises():
+    agg = FedAvg("t")
+    agg.set_nodes_to_aggregate(["a"])
+    with pytest.raises(Exception):
+        agg.set_nodes_to_aggregate(["b"])
+    agg.clear()
+
+
+def test_partial_aggregation_get_model():
+    agg = FedAvg("t")
+    agg.set_nodes_to_aggregate(["a", "b", "c"])
+    agg.add_model(mk_model(1, 1, ["a"]))
+    agg.add_model(mk_model(3, 1, ["b"]))
+    partial = agg.get_model(except_nodes=["a"])
+    assert partial.get_contributors() == ["b"]
+    both = agg.get_model(except_nodes=[])
+    assert both.get_contributors() == ["a", "b"]
+    np.testing.assert_allclose(np.asarray(both.get_parameters()["w"]), 2.0)
+    assert agg.get_model(except_nodes=["a", "b"]) is None
+    agg.clear()
+
+
+def test_add_model_unblocks_waiter_thread():
+    agg = FedAvg("t")
+    agg.set_nodes_to_aggregate(["a"])
+    result = {}
+
+    def waiter():
+        result["m"] = agg.wait_and_get_aggregation(timeout=5)
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    agg.add_model(mk_model(7, 1, ["a"]))
+    th.join(timeout=5)
+    assert not th.is_alive()
+    np.testing.assert_allclose(np.asarray(result["m"].get_parameters()["w"]), 7.0)
+
+
+# --- SCAFFOLD (reference scaffold_test.py:80-169) ---
+
+
+def scaffold_model(y_val, dy_val, dc_val, contributors):
+    m = mk_model(y_val, 1, contributors)
+    dy = {"w": jnp.full((2, 2), float(dy_val)), "b": jnp.full((2,), float(dy_val))}
+    dc = {"w": jnp.full((2, 2), float(dc_val)), "b": jnp.full((2,), float(dc_val))}
+    m.add_info("scaffold", {"delta_y_i": dy, "delta_c_i": dc})
+    return m
+
+
+def test_scaffold_math_hand_computed():
+    agg = Scaffold("t", global_lr=1.0)
+    # round-start x = y - dy = 5 - 1 = 4 for the first model
+    out = agg.aggregate(
+        [scaffold_model(5, 1, 0.5, ["a"]), scaffold_model(7, 3, 1.5, ["b"])]
+    )
+    # x_new = 4 + mean(1,3) = 6 ; c = 0 + mean(0.5,1.5) = 1
+    np.testing.assert_allclose(np.asarray(out.get_parameters()["w"]), 6.0)
+    np.testing.assert_allclose(
+        np.asarray(out.get_info("scaffold")["global_c"]["w"]), 1.0
+    )
+    # second round: variates persist
+    out2 = agg.aggregate([scaffold_model(9, 1, 1.0, ["a"])])
+    np.testing.assert_allclose(np.asarray(out2.get_parameters()["w"]), 7.0)
+    np.testing.assert_allclose(
+        np.asarray(out2.get_info("scaffold")["global_c"]["w"]), 2.0
+    )
+
+
+def test_scaffold_missing_info_raises():
+    agg = Scaffold("t")
+    with pytest.raises(ValueError):
+        agg.aggregate([mk_model(1, 1, ["a"])])
+    with pytest.raises(ValueError):
+        agg.aggregate([])
+
+
+def test_scaffold_requires_callback():
+    assert Scaffold("t").get_required_callbacks() == ["scaffold"]
+    assert FedProx("t").get_required_callbacks() == ["fedprox"]
+    assert FedAvg("t").get_required_callbacks() == []

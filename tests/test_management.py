@@ -1,0 +1,63 @@
+"""Logger + metric storage tests (reference has placeholder tests here;
+we test the real contracts: routing, dedup, registry)."""
+
+import logging
+
+from tpfl.management.metric_storage import GlobalMetricStorage, LocalMetricStorage
+from tpfl.management.logger import TpflLogger, WebLogger
+
+
+def test_local_metric_storage_shape():
+    s = LocalMetricStorage()
+    s.add_log("exp1", 0, "loss", "node-a", 1.5, step=0)
+    s.add_log("exp1", 0, "loss", "node-a", 1.2, step=1)
+    logs = s.get_all_logs()
+    assert logs["exp1"][0]["node-a"]["loss"] == [(0, 1.5), (1, 1.2)]
+    assert s.get_experiment_round_node_logs("exp1", 0, "node-a")["loss"][0] == (0, 1.5)
+
+
+def test_global_metric_storage_dedups_round():
+    s = GlobalMetricStorage()
+    s.add_log("exp1", 0, "acc", "node-a", 0.5)
+    s.add_log("exp1", 0, "acc", "node-a", 0.9)  # dup round -> dropped
+    s.add_log("exp1", 1, "acc", "node-a", 0.7)
+    assert s.get_experiment_node_logs("exp1", "node-a")["acc"] == [(0, 0.5), (1, 0.7)]
+
+
+def test_logger_metric_routing():
+    lg = WebLogger(TpflLogger())
+    lg.set_level(logging.CRITICAL)
+
+    class FakeExp:
+        exp_name = "expX"
+        round = 3
+
+    lg.register_node("n1")
+    lg.experiment_started("n1", FakeExp())
+    lg.log_metric("n1", "accuracy", 0.8)  # no step -> global at round 3
+    lg.log_metric("n1", "loss", 0.4, step=7)  # step -> local
+    assert lg.get_global_logs()["expX"]["n1"]["accuracy"] == [(3, 0.8)]
+    assert lg.get_local_logs()["expX"][3]["n1"]["loss"] == [(7, 0.4)]
+    lg.unregister_node("n1")
+    assert "n1" not in lg.get_nodes()
+
+
+def test_logger_register_twice_raises():
+    lg = WebLogger(TpflLogger())
+    lg.set_level(logging.CRITICAL)
+    lg.register_node("dup")
+    try:
+        lg.register_node("dup")
+        assert False, "expected raise"
+    except Exception:
+        pass
+
+
+def test_settings_profiles_and_snapshot():
+    from tpfl.settings import Settings
+
+    snap = Settings.snapshot()
+    assert "TRAIN_SET_SIZE" in snap
+    Settings.TRAIN_SET_SIZE = 99
+    Settings.restore(snap)
+    assert Settings.TRAIN_SET_SIZE == snap["TRAIN_SET_SIZE"]

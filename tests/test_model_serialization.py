@@ -1,0 +1,107 @@
+"""TpflModel + msgpack serialization tests (reference
+frameworks_test.py:63-226 get/set/encode round-trips, wrong-shape errors)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpfl.exceptions import DecodingParamsError, ModelNotMatchingError
+from tpfl.learning import serialization
+from tpfl.learning.model import TpflModel
+
+
+def make_params(seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "dense1": {
+            "kernel": jnp.asarray(rng.normal(size=(4, 8)), dtype=jnp.float32),
+            "bias": jnp.zeros((8,), jnp.float32),
+        },
+        "dense2": {
+            "kernel": jnp.asarray(rng.normal(size=(8, 2)), dtype=jnp.bfloat16),
+            "bias": jnp.ones((2,), jnp.float32),
+        },
+    }
+
+
+def test_pytree_roundtrip_preserves_dtype_shape():
+    params = make_params()
+    data = serialization.encode_pytree(params)
+    back = serialization.decode_pytree(data)
+    assert np.asarray(back["dense2"]["kernel"]).dtype == np.dtype("bfloat16") or str(
+        np.asarray(back["dense2"]["kernel"]).dtype
+    ) == "bfloat16"
+    np.testing.assert_array_equal(
+        np.asarray(params["dense1"]["kernel"]), back["dense1"]["kernel"]
+    )
+
+
+def test_model_payload_roundtrip():
+    params = make_params()
+    blob = serialization.encode_model_payload(
+        params, ["node-a", "node-b"], 123, {"scaffold": {"x": np.arange(3)}}
+    )
+    p, contribs, n, info = serialization.decode_model_payload(blob)
+    assert contribs == ["node-a", "node-b"]
+    assert n == 123
+    np.testing.assert_array_equal(info["scaffold"]["x"], np.arange(3))
+    np.testing.assert_array_equal(
+        np.asarray(params["dense1"]["bias"]), p["dense1"]["bias"]
+    )
+
+
+def test_decode_garbage_raises():
+    with pytest.raises(DecodingParamsError):
+        serialization.decode_pytree(b"not msgpack at all \x00\xff")
+    with pytest.raises(DecodingParamsError):
+        serialization.decode_model_payload(b"\x93\x01\x02\x03")
+
+
+def test_model_set_parameters_shape_check():
+    m = TpflModel(params=make_params())
+    bad = make_params()
+    bad["dense1"]["kernel"] = jnp.zeros((3, 3), jnp.float32)
+    with pytest.raises(ModelNotMatchingError):
+        m.set_parameters(bad)
+
+
+def test_model_set_parameters_from_flat_list():
+    m = TpflModel(params=make_params(0))
+    other = make_params(1)
+    flat = [np.asarray(x) for x in __import__("jax").tree_util.tree_leaves(other)]
+    m.set_parameters(flat)
+    np.testing.assert_allclose(
+        np.asarray(m.get_parameters()["dense1"]["kernel"], dtype=np.float32),
+        np.asarray(other["dense1"]["kernel"], dtype=np.float32),
+    )
+    with pytest.raises(ModelNotMatchingError):
+        m.set_parameters(flat[:-1])
+
+
+def test_model_bytes_roundtrip_and_metadata():
+    m = TpflModel(params=make_params())
+    m.set_contribution(["a"], 10)
+    blob = m.encode_parameters()
+    m2 = TpflModel(params=make_params(3))
+    m2.set_parameters(blob)
+    assert m2.get_contributors() == ["a"]
+    assert m2.get_num_samples() == 10
+    np.testing.assert_allclose(
+        m2.get_parameters_list()[0], m.get_parameters_list()[0]
+    )
+
+
+def test_build_copy_independent():
+    m = TpflModel(params=make_params())
+    c = m.build_copy(params=make_params(5), contributors=["x"], num_samples=7)
+    assert c.get_num_samples() == 7
+    assert c.get_contributors() == ["x"]
+    assert m.get_num_samples() == 1  # original untouched
+
+
+def test_apply_to_params_sign_flip():
+    m = TpflModel(params=make_params())
+    before = m.get_parameters_list()
+    m.apply_to_params(lambda x: -x)
+    after = m.get_parameters_list()
+    np.testing.assert_allclose(after[0], -before[0])

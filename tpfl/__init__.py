@@ -1,0 +1,27 @@
+"""tpfl — TPU-native peer-to-peer federated learning.
+
+A ground-up JAX/XLA re-design of the capabilities of p2pfl (reference:
+PrivEimantas/myFYP): serverless gossip-based decentralized federated
+learning with per-round train-set election, local training, FedAvg /
+SCAFFOLD aggregation, model gossip, heartbeat membership, in-memory and
+gRPC transports, large-scale single-pod simulation, adversarial attack
+injection, and seeded reproducibility.
+
+Design principles (vs. the reference's threads + pickled numpy + Lightning):
+
+- Model weights are pytrees of ``jax.Array``; serialization is a
+  dtype-preserving msgpack envelope, never pickle.
+- Local training is a jitted optax loop; evaluation is jitted metric
+  computation (accuracy / F1 / precision / recall).
+- Aggregation math (FedAvg, SCAFFOLD, median) is jitted ``tree_map`` code
+  that runs on-device; inside a slice it can be an exact ``psum`` over ICI
+  instead of gossip-until-converged.
+- Whole federations simulate on one pod by vmapping the per-node train
+  step over a stacked node axis (``tpfl.parallel``).
+"""
+
+from tpfl.settings import Settings
+
+__version__ = "0.1.0"
+
+__all__ = ["Settings", "__version__"]

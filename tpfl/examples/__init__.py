@@ -1,0 +1,1 @@
+"""Bundled runnable experiments (reference p2pfl/examples/)."""

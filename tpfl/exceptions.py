@@ -1,0 +1,37 @@
+"""Framework exceptions — parity with p2pfl/exceptions.py."""
+
+
+class TpflError(Exception):
+    """Base class for all tpfl errors."""
+
+
+class NodeRunningException(TpflError):
+    """Operation invalid while the node is (or is not) running."""
+
+
+class LearnerRunningException(TpflError):
+    """Operation invalid while the learner is (or is not) running."""
+
+
+class ZeroRoundsException(TpflError):
+    """An experiment was started with zero rounds."""
+
+
+class ModelNotMatchingError(TpflError):
+    """Incoming parameters do not match the model's structure/shapes."""
+
+
+class DecodingParamsError(TpflError):
+    """Serialized parameters could not be decoded."""
+
+
+class NodeNotRunning(TpflError):
+    """A communication operation was attempted on a stopped node."""
+
+
+class NeighborNotConnectedError(TpflError):
+    """Tried to talk to an address that is not a connected neighbor."""
+
+
+class CommunicationError(TpflError):
+    """Transport-level send/connect failure."""

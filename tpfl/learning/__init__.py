@@ -1,0 +1,5 @@
+"""Learning layer: model wrapper, learner, aggregators, datasets, callbacks.
+
+Reference: p2pfl/learning/ (frameworks/p2pfl_model.py:30, frameworks/learner.py:33,
+aggregators/aggregator.py:35, dataset/p2pfl_dataset.py:55).
+"""

@@ -1,0 +1,21 @@
+"""Aggregators: thread-safe per-round aggregation state machines with
+jitted on-device math. Reference: p2pfl/learning/aggregators/."""
+
+from tpfl.learning.aggregators.aggregator import Aggregator, NoModelsToAggregateError
+from tpfl.learning.aggregators.fedavg import FedAvg
+from tpfl.learning.aggregators.fedmedian import FedMedian
+from tpfl.learning.aggregators.fedprox import FedProx
+from tpfl.learning.aggregators.scaffold import Scaffold
+from tpfl.learning.aggregators.robust import Krum, MultiKrum, TrimmedMean
+
+__all__ = [
+    "Aggregator",
+    "NoModelsToAggregateError",
+    "FedAvg",
+    "FedMedian",
+    "FedProx",
+    "Scaffold",
+    "Krum",
+    "MultiKrum",
+    "TrimmedMean",
+]

@@ -1,0 +1,189 @@
+"""Aggregation state machine.
+
+Parity with reference ``p2pfl/learning/aggregators/aggregator.py:35``:
+
+- ``set_nodes_to_aggregate``            aggregator.py:76-91
+- thread-safe ``add_model`` with contributor-subset checks, setting a
+  finish event when the whole train set is covered   aggregator.py:113-175
+- ``wait_and_get_aggregation(timeout)``  aggregator.py:177-208
+- partial aggregation ``get_model(except_nodes)``    aggregator.py:224-270
+- ``get_required_callbacks``             aggregator.py:66-74
+
+The math itself lives in subclasses' :meth:`aggregate`, which operates on
+pytrees with jitted ``tree_map`` code — aggregation runs on-device (TPU)
+instead of the reference's host numpy loops.
+"""
+
+from __future__ import annotations
+
+import threading
+from abc import ABC, abstractmethod
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from tpfl.learning.model import TpflModel
+from tpfl.management.logger import logger
+from tpfl.settings import Settings
+
+
+class NoModelsToAggregateError(Exception):
+    """wait_and_get_aggregation timed out with zero models."""
+
+
+def stack_models(models: list[TpflModel]) -> tuple[Any, jnp.ndarray]:
+    """Stack N parameter pytrees along a leading node axis and return the
+    per-model sample counts. The stacked tree is what jitted aggregation
+    math consumes — one fused XLA op per leaf instead of a python loop
+    over layers (reference fedavg.py:41-76)."""
+    trees = [m.get_parameters() for m in models]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
+    weights = jnp.asarray([float(m.get_num_samples()) for m in models])
+    return stacked, weights
+
+
+class Aggregator(ABC):
+    """Per-round aggregation state machine, one per node."""
+
+    SUPPORTS_PARTIAL_AGGREGATION: bool = False
+    REQUIRED_CALLBACKS: list[str] = []
+
+    def __init__(self, node_name: str = "unknown") -> None:
+        self.node_name = node_name
+        self._train_set: list[str] = []
+        self._models: list[TpflModel] = []
+        self._lock = threading.Lock()
+        self._finish_aggregation_event = threading.Event()
+        self._finish_aggregation_event.set()
+
+    # --- math (subclasses) ---
+
+    @abstractmethod
+    def aggregate(self, models: list[TpflModel]) -> TpflModel:
+        """Combine models into one. Pure function of the inputs."""
+
+    def get_required_callbacks(self) -> list[str]:
+        return list(self.REQUIRED_CALLBACKS)
+
+    # --- round lifecycle ---
+
+    def set_nodes_to_aggregate(self, nodes: list[str]) -> None:
+        """Start a round: declare the train set whose contributions we
+        await (reference aggregator.py:76-91)."""
+        if not self._finish_aggregation_event.is_set():
+            raise Exception(
+                f"({self.node_name}) Aggregation already in progress"
+            )
+        with self._lock:
+            self._train_set = list(nodes)
+            self._models = []
+        self._finish_aggregation_event.clear()
+
+    def clear(self) -> None:
+        """End a round (reference RoundFinishedStage calls this)."""
+        with self._lock:
+            self._train_set = []
+            self._models = []
+        self._finish_aggregation_event.set()
+
+    # --- model intake ---
+
+    def get_aggregated_models(self) -> list[str]:
+        """Contributors covered so far."""
+        with self._lock:
+            return [c for m in self._models for c in m.get_contributors()]
+
+    def get_missing_models(self) -> set[str]:
+        with self._lock:
+            covered = {c for m in self._models for c in m.get_contributors()}
+            return set(self._train_set) - covered
+
+    def add_model(self, model: TpflModel) -> list[str]:
+        """Add a (possibly partially-aggregated) model; returns the list
+        of contributors now covered, or [] if the model was rejected
+        (reference aggregator.py:113-175)."""
+        try:
+            contributors = model.get_contributors()
+        except ValueError:
+            logger.debug(self.node_name, "Dropping model with no contributors")
+            return []
+        if self._finish_aggregation_event.is_set():
+            logger.debug(
+                self.node_name, "Dropping model: no aggregation in progress"
+            )
+            return []
+        with self._lock:
+            if not self._train_set:
+                logger.debug(self.node_name, "Dropping model: no train set")
+                return []
+            if not set(contributors).issubset(self._train_set):
+                logger.debug(
+                    self.node_name,
+                    f"Dropping model: contributors {contributors} not in train set",
+                )
+                return []
+            covered = {c for m in self._models for c in m.get_contributors()}
+            if set(contributors).issubset(covered):
+                logger.debug(
+                    self.node_name,
+                    f"Dropping model: contributors {contributors} already covered",
+                )
+                return []
+            if covered & set(contributors):
+                # Overlap would double-count in a weighted mean.
+                logger.debug(
+                    self.node_name,
+                    f"Dropping model: contributors {contributors} overlap {covered}",
+                )
+                return []
+            self._models.append(model)
+            covered |= set(contributors)
+            logger.debug(
+                self.node_name,
+                f"Model added ({len(covered)}/{len(self._train_set)}) from {contributors}",
+            )
+            if covered == set(self._train_set):
+                self._finish_aggregation_event.set()
+            return sorted(covered)
+
+    # --- results ---
+
+    def wait_and_get_aggregation(self, timeout: float | None = None) -> TpflModel:
+        """Block until the train set is fully covered (or timeout), then
+        run the aggregation math (reference aggregator.py:177-208)."""
+        if timeout is None:
+            timeout = Settings.AGGREGATION_TIMEOUT
+        finished = self._finish_aggregation_event.wait(timeout=timeout)
+        with self._lock:
+            models = list(self._models)
+        if not finished:
+            missing = self.get_missing_models()
+            logger.warning(
+                self.node_name,
+                f"Aggregation timed out; proceeding without {missing}",
+            )
+        if not models:
+            raise NoModelsToAggregateError(
+                f"({self.node_name}) No models to aggregate"
+            )
+        return self.aggregate(models)
+
+    def get_model(self, except_nodes: list[str] | None = None) -> TpflModel | None:
+        """Partial aggregate of held models excluding contributions from
+        ``except_nodes`` — what we gossip to a peer that already has those
+        (reference aggregator.py:224-270). Returns None if nothing to send."""
+        except_nodes = except_nodes or []
+        with self._lock:
+            usable = [
+                m
+                for m in self._models
+                if not (set(m.get_contributors()) & set(except_nodes))
+            ]
+        if not usable:
+            return None
+        if len(usable) == 1:
+            return usable[0]
+        if not self.SUPPORTS_PARTIAL_AGGREGATION:
+            return None
+        return self.aggregate(usable)

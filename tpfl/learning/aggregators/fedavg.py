@@ -1,0 +1,44 @@
+"""FedAvg — sample-weighted parameter mean (McMahan et al. 2016).
+
+Parity with reference ``p2pfl/learning/aggregators/fedavg.py:29-76``, but
+the math is a single jitted sample-weighted tensor contraction per leaf
+on stacked pytrees — it runs fused on the TPU instead of a python loop of
+numpy adds.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from tpfl.learning.aggregators.aggregator import Aggregator, stack_models
+from tpfl.learning.model import TpflModel
+
+
+@jax.jit
+def _weighted_mean(stacked, weights):
+    """sum_i w_i * x_i / sum_i w_i along the leading node axis."""
+    norm = weights / jnp.sum(weights)
+
+    def leaf_mean(x):
+        w = norm.astype(jnp.promote_types(x.dtype, jnp.float32))
+        return jnp.tensordot(w, x.astype(w.dtype), axes=1).astype(x.dtype)
+
+    return jax.tree_util.tree_map(leaf_mean, stacked)
+
+
+class FedAvg(Aggregator):
+    """Weighted average of models (partial aggregation supported)."""
+
+    SUPPORTS_PARTIAL_AGGREGATION = True
+
+    def aggregate(self, models: list[TpflModel]) -> TpflModel:
+        if not models:
+            raise ValueError("No models to aggregate")
+        stacked, weights = stack_models(models)
+        avg = _weighted_mean(stacked, weights)
+        contributors = sorted({c for m in models for c in m.get_contributors()})
+        total = int(sum(m.get_num_samples() for m in models))
+        return models[0].build_copy(
+            params=avg, contributors=contributors, num_samples=total
+        )

@@ -1,0 +1,23 @@
+"""FedProx — FedAvg aggregation + client-side proximal regularization
+(Li et al. 2018).
+
+Server-side FedProx is identical to FedAvg; the difference is the
+``mu/2 * ||w - w_global||^2`` proximal term added to each client's local
+loss, implemented here as the ``fedprox`` learner callback
+(:mod:`tpfl.learning.callbacks.fedprox_callback`). Listed in the build's
+target configs (BASELINE.md config 3).
+"""
+
+from __future__ import annotations
+
+from tpfl.learning.aggregators.fedavg import FedAvg
+
+
+class FedProx(FedAvg):
+    """FedAvg + required 'fedprox' callback injecting the proximal term."""
+
+    REQUIRED_CALLBACKS = ["fedprox"]
+
+    def __init__(self, node_name: str = "unknown", proximal_mu: float = 0.01) -> None:
+        super().__init__(node_name)
+        self.proximal_mu = float(proximal_mu)

@@ -1,0 +1,129 @@
+"""Byzantine-robust aggregators: Krum, Multi-Krum, trimmed mean.
+
+Not present in the reference, but the fork's raison d'être is adversarial
+robustness experimentation (sign-flip / additive-noise attacks,
+``exp_SAVE3.txt:60-234``) — these are the standard defenses to evaluate
+those attacks against. All scoring is jitted: pairwise distances are one
+``(n, p) x (p, n)`` matmul on the MXU.
+
+- Krum / Multi-Krum: Blanchard et al. 2017.
+- Trimmed mean: Yin et al. 2018.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from tpfl.learning.aggregators.aggregator import Aggregator, stack_models
+from tpfl.learning.model import TpflModel
+
+
+@jax.jit
+def _flatten_stacked(stacked):
+    """(n_models, total_params) matrix from a stacked pytree."""
+    leaves = jax.tree_util.tree_leaves(stacked)
+    return jnp.concatenate(
+        [x.reshape(x.shape[0], -1).astype(jnp.float32) for x in leaves], axis=1
+    )
+
+
+@partial(jax.jit, static_argnums=(1,))
+def _krum_scores(flat, n_byzantine: int):
+    """Krum score per model: sum of squared distances to its n-f-2
+    closest peers. Pairwise distances via the Gram matrix (MXU-friendly)."""
+    sq = jnp.sum(flat * flat, axis=1)
+    d2 = sq[:, None] + sq[None, :] - 2.0 * flat @ flat.T  # (n, n)
+    n = flat.shape[0]
+    d2 = d2.at[jnp.arange(n), jnp.arange(n)].set(jnp.inf)
+    k = max(n - n_byzantine - 2, 1)
+    nearest = jnp.sort(d2, axis=1)[:, :k]
+    return jnp.sum(nearest, axis=1)
+
+
+@partial(jax.jit, static_argnums=(1,))
+def _trimmed_mean(stacked, trim: int):
+    def leaf(x):
+        xs = jnp.sort(x.astype(jnp.float32), axis=0)
+        n = xs.shape[0]
+        kept = xs[trim : n - trim] if n > 2 * trim else xs
+        return jnp.mean(kept, axis=0).astype(x.dtype)
+
+    return jax.tree_util.tree_map(leaf, stacked)
+
+
+class Krum(Aggregator):
+    """Select the single model closest to its peers (byzantine-robust)."""
+
+    SUPPORTS_PARTIAL_AGGREGATION = False
+
+    def __init__(self, node_name: str = "unknown", n_byzantine: int = 1) -> None:
+        super().__init__(node_name)
+        self.n_byzantine = int(n_byzantine)
+
+    def aggregate(self, models: list[TpflModel]) -> TpflModel:
+        if not models:
+            raise ValueError("No models to aggregate")
+        if len(models) == 1:
+            return models[0]
+        stacked, _ = stack_models(models)
+        scores = _krum_scores(_flatten_stacked(stacked), self.n_byzantine)
+        best = int(jnp.argmin(scores))
+        chosen = models[best]
+        contributors = sorted({c for m in models for c in m.get_contributors()})
+        return chosen.build_copy(
+            params=chosen.get_parameters(),
+            contributors=contributors,
+            num_samples=chosen.get_num_samples(),
+        )
+
+
+class MultiKrum(Krum):
+    """Average of the m best-scored models."""
+
+    def __init__(
+        self, node_name: str = "unknown", n_byzantine: int = 1, m: int = 2
+    ) -> None:
+        super().__init__(node_name, n_byzantine)
+        self.m = int(m)
+
+    def aggregate(self, models: list[TpflModel]) -> TpflModel:
+        if not models:
+            raise ValueError("No models to aggregate")
+        if len(models) <= self.m:
+            selected = models
+        else:
+            stacked, _ = stack_models(models)
+            scores = _krum_scores(_flatten_stacked(stacked), self.n_byzantine)
+            order = jnp.argsort(scores)[: self.m]
+            selected = [models[int(i)] for i in order]
+        from tpfl.learning.aggregators.fedavg import FedAvg
+
+        avg = FedAvg(self.node_name)
+        out = avg.aggregate(selected)
+        contributors = sorted({c for m in models for c in m.get_contributors()})
+        out.set_contribution(contributors, out.get_num_samples())
+        return out
+
+
+class TrimmedMean(Aggregator):
+    """Coordinate-wise mean after trimming the k extremes per side."""
+
+    SUPPORTS_PARTIAL_AGGREGATION = False
+
+    def __init__(self, node_name: str = "unknown", trim: int = 1) -> None:
+        super().__init__(node_name)
+        self.trim = int(trim)
+
+    def aggregate(self, models: list[TpflModel]) -> TpflModel:
+        if not models:
+            raise ValueError("No models to aggregate")
+        stacked, _ = stack_models(models)
+        out = _trimmed_mean(stacked, self.trim)
+        contributors = sorted({c for m in models for c in m.get_contributors()})
+        total = int(sum(m.get_num_samples() for m in models))
+        return models[0].build_copy(
+            params=out, contributors=contributors, num_samples=total
+        )

@@ -1,0 +1,103 @@
+"""SCAFFOLD — stochastic controlled averaging (Karimireddy et al. 2019).
+
+Parity with reference ``p2pfl/learning/aggregators/scaffold.py:29-124``:
+no partial aggregation; the aggregator maintains the global control
+variate ``c`` and a simulated global model; it consumes ``delta_y_i`` /
+``delta_c_i`` from each model's ``additional_info`` (shipped by the
+required ``scaffold`` learner callback) and emits ``global_c`` back to
+the clients. All variate math is jitted pytree arithmetic.
+
+Update rule (option II of the paper, as in the reference):
+
+    x      <- x + eta_g * mean_i(delta_y_i)
+    c      <- c + mean_i(delta_c_i) * (|S| / N)   [N == |S| here]
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpfl.learning.aggregators.aggregator import Aggregator
+from tpfl.learning.model import TpflModel
+
+INFO_KEY = "scaffold"
+
+
+@jax.jit
+def _tree_mean(stacked):
+    return jax.tree_util.tree_map(lambda x: jnp.mean(x, axis=0), stacked)
+
+
+@jax.jit
+def _tree_axpy(a, x, y):
+    """y + a * x over pytrees."""
+    return jax.tree_util.tree_map(lambda xi, yi: (yi + a * xi).astype(yi.dtype), x, y)
+
+
+def _stack(trees):
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
+
+
+class Scaffold(Aggregator):
+    """Controlled averaging with global/local control variates."""
+
+    SUPPORTS_PARTIAL_AGGREGATION = False
+    REQUIRED_CALLBACKS = ["scaffold"]
+
+    def __init__(self, node_name: str = "unknown", global_lr: float = 1.0) -> None:
+        super().__init__(node_name)
+        self.global_lr = float(global_lr)
+        self._global_params: Optional[Any] = None
+        self._c: Optional[Any] = None
+
+    def aggregate(self, models: list[TpflModel]) -> TpflModel:
+        if not models:
+            raise ValueError("No models to aggregate")
+        delta_ys, delta_cs = [], []
+        for m in models:
+            info = m.get_info().get(INFO_KEY)
+            if not info or "delta_y_i" not in info or "delta_c_i" not in info:
+                raise ValueError(
+                    "SCAFFOLD requires delta_y_i/delta_c_i in model info "
+                    "(is the 'scaffold' callback registered on the learner?)"
+                )
+            delta_ys.append(
+                jax.tree_util.tree_map(jnp.asarray, info["delta_y_i"])
+            )
+            delta_cs.append(
+                jax.tree_util.tree_map(jnp.asarray, info["delta_c_i"])
+            )
+
+        mean_dy = _tree_mean(_stack(delta_ys))
+        mean_dc = _tree_mean(_stack(delta_cs))
+
+        if self._global_params is None:
+            # Recover the common round-start point x from any client:
+            # y_i = x + delta_y_i  =>  x = y_0 - delta_y_0.
+            self._global_params = jax.tree_util.tree_map(
+                lambda y, d: y - d.astype(y.dtype),
+                models[0].get_parameters(),
+                delta_ys[0],
+            )
+        self._global_params = _tree_axpy(self.global_lr, mean_dy, self._global_params)
+
+        if self._c is None:
+            self._c = jax.tree_util.tree_map(jnp.zeros_like, mean_dc)
+        self._c = _tree_axpy(1.0, mean_dc, self._c)
+
+        contributors = sorted({c for m in models for c in m.get_contributors()})
+        total = int(sum(m.get_num_samples() for m in models))
+        out = models[0].build_copy(
+            params=self._global_params, contributors=contributors, num_samples=total
+        )
+        out.add_info(INFO_KEY, {"global_c": self._c})
+        return out
+
+    def clear(self) -> None:
+        # Keep control variates across rounds (they are the whole point);
+        # only per-round intake state resets.
+        super().clear()

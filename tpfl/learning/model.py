@@ -1,0 +1,209 @@
+"""Framework-neutral model container, TPU-native.
+
+Replaces the reference's ``P2PFLModel``
+(``p2pfl/learning/frameworks/p2pfl_model.py:30``): instead of a list of
+CPU numpy arrays moved around by pickle, a :class:`TpflModel` holds a
+**pytree of on-device arrays** plus the federated-learning metadata the
+protocol needs (``contributors``, ``num_samples``, ``additional_info``).
+
+Key API parity (reference line refs):
+
+- ``get_parameters`` / ``set_parameters``      p2pfl_model.py:103-124
+- ``encode_parameters`` / ``decode_parameters`` p2pfl_model.py:71-101
+- ``contributors`` + ``num_samples`` tracking   p2pfl_model.py:150-172
+- ``build_copy``                                p2pfl_model.py:174-185
+- ``add_info`` / ``get_info``                   p2pfl_model.py:126-148
+
+TPU-native differences: parameters stay as a pytree (XLA-aggregatable via
+``tree_map`` without host round-trips); serialization is msgpack, never
+pickle; ``set_parameters`` also accepts a flat leaf list for aggregator
+interop tests.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpfl.exceptions import ModelNotMatchingError
+from tpfl.learning import serialization
+
+Pytree = Any
+
+
+class TpflModel:
+    """A pytree of weights + FL metadata.
+
+    Args:
+        module: optional model definition (e.g. a ``flax.linen.Module``);
+            carried so learners can apply the weights. Not serialized.
+        params: pytree of arrays (nested dicts, as flax produces).
+        num_samples: samples used to train these weights (FedAvg weight).
+        contributors: node addresses whose training produced the weights.
+        additional_info: arbitrary pytree payload for aggregator/callback
+            state transport (e.g. SCAFFOLD control variates).
+        aux_state: optional non-trained state (e.g. batch-norm stats).
+    """
+
+    def __init__(
+        self,
+        module: Any = None,
+        params: Optional[Pytree] = None,
+        num_samples: int = 1,
+        contributors: Optional[list[str]] = None,
+        additional_info: Optional[dict[str, Any]] = None,
+        aux_state: Optional[Pytree] = None,
+    ) -> None:
+        self.module = module
+        self._params: Pytree = params if params is not None else {}
+        self._num_samples = int(num_samples)
+        self._contributors: list[str] = list(contributors or [])
+        self.additional_info: dict[str, Any] = dict(additional_info or {})
+        self.aux_state = aux_state
+
+    # --- parameters ---
+
+    def get_parameters(self) -> Pytree:
+        """The parameter pytree (on-device arrays)."""
+        return self._params
+
+    def get_parameters_list(self) -> list[np.ndarray]:
+        """Flat leaf view as host numpy arrays (reference-compatible)."""
+        return [np.asarray(x) for x in jax.tree_util.tree_leaves(self._params)]
+
+    def set_parameters(
+        self, params: Union["TpflModel", Pytree, list, bytes]
+    ) -> None:
+        """Accepts a TpflModel, a pytree, a flat leaf list, or encoded
+        bytes (reference learner.py:66-80 seam)."""
+        if isinstance(params, TpflModel):
+            self._params = params.get_parameters()
+            return
+        if isinstance(params, bytes):
+            decoded, contribs, n, info = serialization.decode_model_payload(params)
+            self._check_and_set(decoded)
+            self._contributors = contribs
+            self._num_samples = n
+            self.additional_info.update(info)
+            return
+        if isinstance(params, list) and self._params:
+            # flat leaf list -> unflatten into our structure
+            treedef = jax.tree_util.tree_structure(self._params)
+            if treedef.num_leaves != len(params):
+                raise ModelNotMatchingError(
+                    f"Expected {treedef.num_leaves} leaves, got {len(params)}"
+                )
+            self._check_and_set(
+                jax.tree_util.tree_unflatten(treedef, [jnp.asarray(p) for p in params])
+            )
+            return
+        self._check_and_set(params)
+
+    def _check_and_set(self, new_params: Pytree) -> None:
+        if self._params:
+            old_leaves = jax.tree_util.tree_leaves(self._params)
+            new_leaves = jax.tree_util.tree_leaves(new_params)
+            if len(old_leaves) != len(new_leaves):
+                raise ModelNotMatchingError(
+                    f"Leaf count mismatch: {len(old_leaves)} vs {len(new_leaves)}"
+                )
+            for o, n in zip(old_leaves, new_leaves):
+                if tuple(np.shape(o)) != tuple(np.shape(n)):
+                    raise ModelNotMatchingError(
+                        f"Shape mismatch: {np.shape(o)} vs {np.shape(n)}"
+                    )
+        self._params = jax.tree_util.tree_map(jnp.asarray, new_params)
+
+    # --- serialization (msgpack, not pickle) ---
+
+    def encode_parameters(self, params: Optional[Pytree] = None) -> bytes:
+        return serialization.encode_model_payload(
+            params if params is not None else self._params,
+            self._contributors,
+            self._num_samples,
+            self.additional_info,
+        )
+
+    def decode_parameters(self, data: bytes) -> Pytree:
+        params, contribs, n, info = serialization.decode_model_payload(data)
+        return params
+
+    # --- FL metadata ---
+
+    def get_num_samples(self) -> int:
+        return self._num_samples
+
+    def set_num_samples(self, n: int) -> None:
+        if n < 0:
+            raise ValueError("num_samples must be >= 0")
+        self._num_samples = int(n)
+
+    def get_contributors(self) -> list[str]:
+        if not self._contributors:
+            raise ValueError("Contributors not set on this model")
+        return self._contributors
+
+    def set_contribution(self, contributors: list[str], num_samples: int) -> None:
+        self._contributors = list(contributors)
+        self.set_num_samples(num_samples)
+
+    # --- info transport (callback/aggregator state) ---
+
+    def add_info(self, key: str, value: Any) -> None:
+        self.additional_info[key] = value
+
+    def get_info(self, key: Optional[str] = None) -> Any:
+        if key is None:
+            return self.additional_info
+        return self.additional_info[key]
+
+    # --- copies ---
+
+    def build_copy(self, **kwargs: Any) -> "TpflModel":
+        """New model sharing the module but with fresh params/metadata
+        (reference p2pfl_model.py:174-185). Accepts ``params`` as pytree,
+        flat list, or encoded bytes."""
+        params = kwargs.pop("params", None)
+        m = TpflModel(
+            module=self.module,
+            params=self._params,
+            num_samples=kwargs.pop("num_samples", 1),
+            contributors=kwargs.pop("contributors", []),
+            additional_info=copy.copy(kwargs.pop("additional_info", {})),
+            aux_state=self.aux_state,
+        )
+        if params is not None:
+            if isinstance(params, bytes):
+                decoded, contribs, n, info = serialization.decode_model_payload(params)
+                m.set_parameters(decoded)
+                m._contributors = contribs
+                m._num_samples = n
+                m.additional_info.update(info)
+            else:
+                m.set_parameters(params)
+        return m
+
+    def get_framework(self) -> str:
+        return "jax"
+
+    # --- convenience ---
+
+    @property
+    def num_parameters(self) -> int:
+        return sum(int(np.prod(np.shape(x))) for x in jax.tree_util.tree_leaves(self._params))
+
+    def apply_to_params(self, fn: Callable[[jnp.ndarray], jnp.ndarray]) -> None:
+        """In-place transform of every leaf — used by attack injection
+        (sign-flip, additive noise; fork feature exp_SAVE3.txt:60-234)."""
+        self._params = jax.tree_util.tree_map(fn, self._params)
+
+    def __repr__(self) -> str:
+        return (
+            f"TpflModel(leaves={len(jax.tree_util.tree_leaves(self._params))}, "
+            f"params={self.num_parameters}, samples={self._num_samples}, "
+            f"contributors={self._contributors})"
+        )

@@ -1,0 +1,120 @@
+"""Dtype-preserving, pickle-free model serialization.
+
+The reference ships weights as pickled lists of numpy arrays
+(``p2pfl/learning/frameworks/p2pfl_model.py:71-101``) — a security hole
+(arbitrary code execution on unpickle) and a dtype hazard. tpfl instead
+uses a msgpack envelope in which every array leaf is encoded as
+``{dtype, shape, raw bytes}`` and pytree structure is preserved as plain
+msgpack maps/lists. Decoding never executes code.
+
+Wire envelope (version 1)::
+
+    {"v": 1,
+     "params": <encoded pytree>,
+     "contributors": [str, ...],
+     "num_samples": int,
+     "info": <encoded pytree>}
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import msgpack
+import numpy as np
+
+from tpfl.exceptions import DecodingParamsError
+
+_ND_KEY = "__nd__"
+_TUPLE_KEY = "__tp__"
+
+WIRE_VERSION = 1
+
+
+def _resolve_dtype(name: str) -> np.dtype:
+    """np.dtype from name, covering ml_dtypes extension types (bfloat16,
+    float8_*) that numpy alone does not know."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _encode_obj(obj: Any) -> Any:
+    """Recursively encode a pytree of arrays/scalars into msgpack-safe types."""
+    # jax.Array, np.ndarray, np scalar — all become tagged raw buffers
+    if hasattr(obj, "__array__") and not isinstance(obj, (bool, int, float, str)):
+        a = np.asarray(obj)
+        # dtype.name (not .str) so ml_dtypes types like bfloat16 survive
+        return {_ND_KEY: 1, "d": a.dtype.name, "s": list(a.shape), "b": a.tobytes()}
+    if isinstance(obj, dict):
+        return {k: _encode_obj(v) for k, v in obj.items()}
+    if isinstance(obj, tuple):
+        return {_TUPLE_KEY: [_encode_obj(v) for v in obj]}
+    if isinstance(obj, list):
+        return [_encode_obj(v) for v in obj]
+    if obj is None or isinstance(obj, (bool, int, float, str, bytes)):
+        return obj
+    raise TypeError(f"Cannot serialize object of type {type(obj)}")
+
+
+def _decode_obj(obj: Any) -> Any:
+    if isinstance(obj, dict):
+        if obj.get(_ND_KEY) == 1:
+            a = np.frombuffer(obj["b"], dtype=_resolve_dtype(obj["d"]))
+            return a.reshape(obj["s"])
+        if _TUPLE_KEY in obj and len(obj) == 1:
+            return tuple(_decode_obj(v) for v in obj[_TUPLE_KEY])
+        return {k: _decode_obj(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_decode_obj(v) for v in obj]
+    return obj
+
+
+def encode_pytree(tree: Any) -> bytes:
+    """Serialize a bare pytree of arrays (no envelope)."""
+    return msgpack.packb(_encode_obj(tree), use_bin_type=True)
+
+
+def decode_pytree(data: bytes) -> Any:
+    try:
+        return _decode_obj(msgpack.unpackb(data, raw=False, strict_map_key=False))
+    except (msgpack.UnpackException, ValueError, KeyError, TypeError, AttributeError) as e:
+        raise DecodingParamsError(f"Corrupt pytree payload: {e}") from e
+
+
+def encode_model_payload(
+    params: Any,
+    contributors: list[str],
+    num_samples: int,
+    additional_info: dict[str, Any],
+) -> bytes:
+    """Full wire envelope for a model exchange (replaces
+    p2pfl_model.py:71-85's pickle)."""
+    env = {
+        "v": WIRE_VERSION,
+        "params": _encode_obj(params),
+        "contributors": list(contributors),
+        "num_samples": int(num_samples),
+        "info": _encode_obj(additional_info),
+    }
+    return msgpack.packb(env, use_bin_type=True)
+
+
+def decode_model_payload(data: bytes) -> tuple[Any, list[str], int, dict[str, Any]]:
+    try:
+        env = msgpack.unpackb(data, raw=False, strict_map_key=False)
+        if env.get("v") != WIRE_VERSION:
+            raise DecodingParamsError(f"Unknown wire version {env.get('v')}")
+        return (
+            _decode_obj(env["params"]),
+            list(env["contributors"]),
+            int(env["num_samples"]),
+            _decode_obj(env["info"]),
+        )
+    except DecodingParamsError:
+        raise
+    except (msgpack.UnpackException, ValueError, KeyError, TypeError, AttributeError) as e:
+        raise DecodingParamsError(f"Corrupt model payload: {e}") from e

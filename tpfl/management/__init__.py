@@ -1,0 +1,9 @@
+"""Observability: logger singleton, metric storage, node monitor.
+
+Reference: p2pfl/management/ (logger/logger.py:87, metric_storage.py:30,158,
+node_monitor.py:31).
+"""
+
+from tpfl.management.logger import logger
+
+__all__ = ["logger"]

@@ -1,0 +1,355 @@
+"""Framework logger: colored stdout + rotating file + optional async queue
++ optional web dashboard push, composed as decorators around a base logger.
+
+Parity with the reference's decorator-composed singleton
+(``p2pfl/management/logger/logger.py:87``, ``logger/decorators/*``,
+``logger/__init__.py:29-35``). The Ray decorator has no equivalent here —
+the tpfl simulation pool shares the logger through the parent process.
+
+Routing rule (reference ``logger.py:266-308``): a metric logged with a
+``step`` goes to the *local* (per-step) store; one logged without goes to
+the *global* (per-round) store.
+"""
+
+from __future__ import annotations
+
+import atexit
+import datetime
+import logging
+import logging.handlers
+import multiprocessing
+import os
+import queue
+from typing import Any, Optional
+
+from tpfl.management.metric_storage import GlobalMetricStorage, LocalMetricStorage
+from tpfl.settings import Settings
+
+#################
+#    Helpers    #
+#################
+
+
+class ColoredFormatter(logging.Formatter):
+    """ANSI-colored stdout formatter (reference logger.py:59-85)."""
+
+    GREY = "\x1b[38;20m"
+    YELLOW = "\x1b[33;20m"
+    RED = "\x1b[31;20m"
+    BOLD_RED = "\x1b[31;1m"
+    BLUE = "\x1b[34;20m"
+    CYAN = "\x1b[36;20m"
+    RESET = "\x1b[0m"
+
+    LEVEL_COLORS = {
+        logging.DEBUG: GREY,
+        logging.INFO: GREY,
+        logging.WARNING: YELLOW,
+        logging.ERROR: RED,
+        logging.CRITICAL: BOLD_RED,
+    }
+
+    def format(self, record: logging.LogRecord) -> str:
+        color = self.LEVEL_COLORS.get(record.levelno, self.GREY)
+        node = getattr(record, "node", "")
+        node_part = f" {self.CYAN}({node}){self.RESET}" if node else ""
+        ts = datetime.datetime.fromtimestamp(record.created).strftime("%H:%M:%S")
+        return (
+            f"{self.BLUE}[ {ts} | {record.levelname} ]{self.RESET}"
+            f"{node_part} {color}{record.getMessage()}{self.RESET}"
+        )
+
+
+class FileFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        node = getattr(record, "node", "")
+        ts = datetime.datetime.fromtimestamp(record.created).isoformat()
+        return f"[{ts}|{record.levelname}|{node}] {record.getMessage()}"
+
+
+#################
+#  Base logger  #
+#################
+
+
+class TpflLogger:
+    """Base logger: python logging + node registry + metric stores."""
+
+    def __init__(self, disable_locks: bool = False) -> None:
+        self._logger = logging.getLogger("tpfl")
+        self._logger.propagate = False
+        self._logger.setLevel(getattr(logging, Settings.LOG_LEVEL, logging.INFO))
+        # fresh handlers (idempotent re-init in tests)
+        for h in list(self._logger.handlers):
+            self._logger.removeHandler(h)
+        sh = logging.StreamHandler()
+        sh.setFormatter(ColoredFormatter())
+        self._logger.addHandler(sh)
+
+        self.local_metrics = LocalMetricStorage()
+        self.global_metrics = GlobalMetricStorage()
+        # addr -> {"simulation": bool, "experiment": Experiment | None, "round": int | None}
+        self._nodes: dict[str, dict[str, Any]] = {}
+
+    # --- levels ---
+
+    def set_level(self, level: int | str) -> None:
+        if isinstance(level, str):
+            level = getattr(logging, level)
+        self._logger.setLevel(level)
+
+    def get_level(self) -> int:
+        return self._logger.level
+
+    def get_level_name(self, lvl: int) -> str:
+        return logging.getLevelName(lvl)
+
+    # --- log methods ---
+
+    def log(self, level: int, node: str, message: str) -> None:
+        self._logger.log(level, message, extra={"node": node})
+
+    def debug(self, node: str, message: str) -> None:
+        self.log(logging.DEBUG, node, message)
+
+    def info(self, node: str, message: str) -> None:
+        self.log(logging.INFO, node, message)
+
+    def warning(self, node: str, message: str) -> None:
+        self.log(logging.WARNING, node, message)
+
+    def error(self, node: str, message: str) -> None:
+        self.log(logging.ERROR, node, message)
+
+    def critical(self, node: str, message: str) -> None:
+        self.log(logging.CRITICAL, node, message)
+
+    # --- metrics (routing: reference logger.py:266-308) ---
+
+    def log_metric(
+        self,
+        addr: str,
+        metric: str,
+        value: float,
+        step: Optional[int] = None,
+        round: Optional[int] = None,
+    ) -> None:
+        info = self._nodes.get(addr)
+        exp_name = "unknown-exp"
+        if info is not None and info.get("experiment") is not None:
+            exp = info["experiment"]
+            exp_name = exp.exp_name
+            if round is None:
+                round = exp.round
+        if round is None:
+            raise ValueError(f"No round info for node {addr}; pass round=")
+        if step is None:
+            self.global_metrics.add_log(exp_name, round, metric, addr, value)
+        else:
+            self.local_metrics.add_log(exp_name, round, metric, addr, value, step)
+
+    def log_system_metric(self, node: str, metric: str, value: float) -> None:
+        """Resource metrics hook (reference logger.py:443-454). Extended by
+        the web decorator; no-op in the base."""
+
+    def get_local_logs(self):
+        return self.local_metrics.get_all_logs()
+
+    def get_global_logs(self):
+        return self.global_metrics.get_all_logs()
+
+    # --- node registry (reference logger.py:342-372) ---
+
+    def register_node(self, node: str, simulation: bool = False) -> None:
+        if node in self._nodes:
+            raise Exception(f"Node {node} already registered.")
+        self._nodes[node] = {"simulation": simulation, "experiment": None}
+
+    def unregister_node(self, node: str) -> None:
+        self._nodes.pop(node, None)
+
+    def get_nodes(self) -> dict[str, dict[str, Any]]:
+        return self._nodes
+
+    # --- experiment lifecycle (reference logger.py:378-421) ---
+
+    def experiment_started(self, node: str, experiment: Any) -> None:
+        self._nodes.setdefault(node, {"simulation": False})["experiment"] = experiment
+        self.info(node, f"Experiment '{getattr(experiment, 'exp_name', '?')}' started")
+
+    def experiment_finished(self, node: str) -> None:
+        self.info(node, "Experiment finished")
+
+    def round_started(self, node: str, experiment: Any) -> None:
+        self._nodes.setdefault(node, {"simulation": False})["experiment"] = experiment
+        self.debug(node, f"Round {getattr(experiment, 'round', '?')} started")
+
+    def round_finished(self, node: str) -> None:
+        self.debug(node, "Round finished")
+
+    # --- cleanup ---
+
+    def cleanup(self) -> None:
+        for h in list(self._logger.handlers):
+            h.close()
+            self._logger.removeHandler(h)
+
+
+###################
+#   Decorators    #
+###################
+
+
+class LoggerDecorator:
+    """Delegating base for logger decorators (reference
+    logger_decorator.py:30)."""
+
+    def __init__(self, inner: TpflLogger | "LoggerDecorator") -> None:
+        self._inner = inner
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._inner, name)
+
+
+class FileLogger(LoggerDecorator):
+    """Rotating file handler in Settings.LOG_DIR (reference
+    file_logger.py:30)."""
+
+    def __init__(self, inner) -> None:
+        super().__init__(inner)
+        os.makedirs(Settings.LOG_DIR, exist_ok=True)
+        handler = logging.handlers.RotatingFileHandler(
+            os.path.join(
+                Settings.LOG_DIR,
+                f"tpfl-{datetime.datetime.now():%Y%m%d-%H%M%S}.log",
+            ),
+            maxBytes=Settings.LOG_FILE_MAX_BYTES,
+            backupCount=Settings.LOG_FILE_BACKUP_COUNT,
+        )
+        handler.setFormatter(FileFormatter())
+        inner._logger.addHandler(handler)
+
+
+class AsyncLogger(LoggerDecorator):
+    """Queue-based non-blocking log emission (reference async_logger.py:29).
+
+    Uses a QueueHandler/QueueListener pair so gRPC handler threads never
+    block on I/O.
+    """
+
+    def __init__(self, inner) -> None:
+        super().__init__(inner)
+        self._queue: queue.Queue | multiprocessing.Queue = queue.Queue(-1)
+        base = inner._logger
+        handlers = list(base.handlers)
+        for h in handlers:
+            base.removeHandler(h)
+        qh = logging.handlers.QueueHandler(self._queue)
+        base.addHandler(qh)
+        self._listener = logging.handlers.QueueListener(
+            self._queue, *handlers, respect_handler_level=True
+        )
+        self._listener.start()
+        atexit.register(self._stop)
+
+    def _stop(self) -> None:
+        try:
+            self._listener.stop()
+        except Exception:
+            pass
+
+    def cleanup(self) -> None:
+        self._stop()
+        self._inner.cleanup()
+
+
+class WebLogger(LoggerDecorator):
+    """Push logs/metrics to a REST dashboard (reference web_logger.py:36-93).
+
+    Lazily attached via :meth:`connect_web`; until then all calls
+    pass through.
+    """
+
+    def __init__(self, inner) -> None:
+        super().__init__(inner)
+        self._web: Any = None
+        self._monitors: dict[str, Any] = {}
+
+    def connect_web(self, url: str, key: str) -> None:
+        from tpfl.management.web_services import TpflWebServices
+
+        self._web = TpflWebServices(url, key)
+
+    def register_node(self, node: str, simulation: bool = False) -> None:
+        self._inner.register_node(node, simulation)
+        if self._web is not None:
+            self._web.register_node(node, simulation)
+            from tpfl.management.node_monitor import NodeMonitor
+
+            mon = NodeMonitor(node, self.log_system_metric)
+            mon.start()
+            self._monitors[node] = mon
+
+    def unregister_node(self, node: str) -> None:
+        self._inner.unregister_node(node)
+        mon = self._monitors.pop(node, None)
+        if mon is not None:
+            mon.stop()
+        if self._web is not None:
+            self._web.unregister_node(node)
+
+    def log(self, level: int, node: str, message: str) -> None:
+        self._inner.log(level, node, message)
+        if self._web is not None:
+            self._web.send_log(
+                str(datetime.datetime.now()),
+                node,
+                self.get_level_name(level),
+                message,
+            )
+
+    def debug(self, node: str, message: str) -> None:
+        self.log(logging.DEBUG, node, message)
+
+    def info(self, node: str, message: str) -> None:
+        self.log(logging.INFO, node, message)
+
+    def warning(self, node: str, message: str) -> None:
+        self.log(logging.WARNING, node, message)
+
+    def error(self, node: str, message: str) -> None:
+        self.log(logging.ERROR, node, message)
+
+    def critical(self, node: str, message: str) -> None:
+        self.log(logging.CRITICAL, node, message)
+
+    def log_metric(self, addr, metric, value, step=None, round=None) -> None:
+        if round is None:
+            # Resolve from the node's experiment so the dashboard never
+            # receives round=null.
+            info = self.get_nodes().get(addr)
+            if info is not None and info.get("experiment") is not None:
+                round = info["experiment"].round
+        self._inner.log_metric(addr, metric, value, step=step, round=round)
+        if self._web is not None:
+            if step is None:
+                self._web.send_global_metric(addr, metric, value, round)
+            else:
+                self._web.send_local_metric(addr, metric, value, step, round)
+
+    def log_system_metric(self, node: str, metric: str, value: float) -> None:
+        if self._web is not None:
+            self._web.send_system_metric(
+                node, metric, value, str(datetime.datetime.now())
+            )
+
+
+def _build_logger() -> WebLogger:
+    base: Any = TpflLogger()
+    if Settings.ASYNC_LOGGER:
+        base = AsyncLogger(base)
+    return WebLogger(base)
+
+
+# Singleton (reference logger/__init__.py:29-35)
+logger = _build_logger()

@@ -1,0 +1,92 @@
+"""Two-tier metric stores.
+
+Parity with reference ``p2pfl/management/metric_storage.py``:
+
+- :class:`LocalMetricStorage` — per-step training metrics,
+  ``exp -> round -> node -> metric -> [(step, value)]``
+  (reference ``metric_storage.py:30``).
+- :class:`GlobalMetricStorage` — per-round evaluation metrics,
+  ``exp -> node -> metric -> [(round, value)]`` with per-round dedup
+  (reference ``metric_storage.py:158,208-210``).
+
+Thread-safe: gRPC handler threads, the learning thread, and the monitor
+thread all log concurrently.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+
+LocalMetrics = dict[str, dict[int, dict[str, dict[str, list[tuple[int, float]]]]]]
+GlobalMetrics = dict[str, dict[str, dict[str, list[tuple[int, float]]]]]
+
+
+class LocalMetricStorage:
+    """exp -> round -> node -> metric -> [(step, value)]"""
+
+    def __init__(self) -> None:
+        self._store: LocalMetrics = {}
+        self._lock = threading.Lock()
+
+    def add_log(
+        self,
+        exp_name: str,
+        round: int,
+        metric: str,
+        node: str,
+        val: float,
+        step: int,
+    ) -> None:
+        with self._lock:
+            exp = self._store.setdefault(exp_name, {})
+            rnd = exp.setdefault(round, {})
+            nd = rnd.setdefault(node, {})
+            nd.setdefault(metric, []).append((step, float(val)))
+
+    def get_all_logs(self) -> LocalMetrics:
+        with self._lock:
+            return copy.deepcopy(self._store)
+
+    def get_experiment_logs(self, exp: str) -> dict:
+        with self._lock:
+            return copy.deepcopy(self._store.get(exp, {}))
+
+    def get_experiment_round_logs(self, exp: str, round: int) -> dict:
+        with self._lock:
+            return copy.deepcopy(self._store.get(exp, {}).get(round, {}))
+
+    def get_experiment_round_node_logs(self, exp: str, round: int, node: str) -> dict:
+        with self._lock:
+            return copy.deepcopy(self._store.get(exp, {}).get(round, {}).get(node, {}))
+
+
+class GlobalMetricStorage:
+    """exp -> node -> metric -> [(round, value)] (deduped per round)"""
+
+    def __init__(self) -> None:
+        self._store: GlobalMetrics = {}
+        self._lock = threading.Lock()
+
+    def add_log(
+        self, exp_name: str, round: int, metric: str, node: str, val: float
+    ) -> None:
+        with self._lock:
+            exp = self._store.setdefault(exp_name, {})
+            nd = exp.setdefault(node, {})
+            series = nd.setdefault(metric, [])
+            # Dedup: only one value per (metric, round) — metric_storage.py:208-210
+            if round not in [r for r, _ in series]:
+                series.append((round, float(val)))
+
+    def get_all_logs(self) -> GlobalMetrics:
+        with self._lock:
+            return copy.deepcopy(self._store)
+
+    def get_experiment_logs(self, exp: str) -> dict:
+        with self._lock:
+            return copy.deepcopy(self._store.get(exp, {}))
+
+    def get_experiment_node_logs(self, exp: str, node: str) -> dict:
+        with self._lock:
+            return copy.deepcopy(self._store.get(exp, {}).get(node, {}))

@@ -1,0 +1,71 @@
+"""Periodic resource sampling thread.
+
+Parity with reference ``p2pfl/management/node_monitor.py:31-82``: samples
+CPU%, RAM%, and network in/out every ``Settings.RESOURCE_MONITOR_PERIOD``
+seconds and pushes each reading through a callback
+(``callback(node, metric, value)``). Also samples TPU/accelerator memory
+when JAX devices expose ``memory_stats`` — the TPU-native addition.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+import psutil
+
+from tpfl.settings import Settings
+
+
+class NodeMonitor(threading.Thread):
+    def __init__(
+        self, node_addr: str, report_fn: Callable[[str, str, float], None]
+    ) -> None:
+        super().__init__(daemon=True, name=f"node-monitor-{node_addr}")
+        self._node = node_addr
+        self._report = report_fn
+        self._running = threading.Event()
+        self._running.set()
+        net = psutil.net_io_counters()
+        self._last_net = (net.bytes_recv, net.bytes_sent, time.monotonic())
+
+    def stop(self) -> None:
+        self._running.clear()
+
+    def run(self) -> None:
+        while self._running.is_set():
+            try:
+                self._sample()
+            except Exception:
+                pass
+            time.sleep(Settings.RESOURCE_MONITOR_PERIOD)
+
+    def _sample(self) -> None:
+        self._report(self._node, "cpu_percent", psutil.cpu_percent())
+        self._report(self._node, "ram_percent", psutil.virtual_memory().percent)
+        net = psutil.net_io_counters()
+        now = time.monotonic()
+        last_recv, last_sent, last_t = self._last_net
+        dt = max(now - last_t, 1e-9)
+        self._report(self._node, "net_in_bytes_per_s", (net.bytes_recv - last_recv) / dt)
+        self._report(self._node, "net_out_bytes_per_s", (net.bytes_sent - last_sent) / dt)
+        self._last_net = (net.bytes_recv, net.bytes_sent, now)
+        self._sample_tpu()
+
+    def _sample_tpu(self) -> None:
+        """TPU-native extension: HBM usage per local device, if available."""
+        try:
+            import jax
+
+            for d in jax.local_devices():
+                stats = getattr(d, "memory_stats", None)
+                if stats is None:
+                    continue
+                s = stats()
+                if s and "bytes_in_use" in s:
+                    self._report(
+                        self._node, f"hbm_bytes_in_use_dev{d.id}", float(s["bytes_in_use"])
+                    )
+        except Exception:
+            pass

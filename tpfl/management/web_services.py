@@ -1,0 +1,88 @@
+"""REST client for the web dashboard.
+
+Parity with reference ``p2pfl/management/p2pfl_web_services.py:58-136``:
+node registration, log push, local/global/system metric push, x-api-key
+auth. Uses stdlib urllib (the reference uses ``requests``) so there is no
+extra dependency; failures are swallowed after logging — observability
+must never take a node down.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Any
+
+
+class TpflWebServices:
+    """Client for a tpfl/p2pfl-style web dashboard."""
+
+    def __init__(self, url: str, key: str) -> None:
+        self._url = url.rstrip("/")
+        self._key = key
+        self._node_sessions: dict[str, Any] = {}
+
+    # --- low-level ---
+
+    def _post(self, path: str, payload: dict) -> dict | None:
+        req = urllib.request.Request(
+            f"{self._url}{path}",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json", "x-api-key": self._key},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                body = resp.read()
+                return json.loads(body) if body else {}
+        except (urllib.error.URLError, OSError, ValueError):
+            return None
+
+    # --- API (mirrors p2pfl_web_services.py) ---
+
+    def register_node(self, node: str, is_simulated: bool) -> None:
+        resp = self._post(
+            "/node", {"address": node, "is_simulated": is_simulated}
+        )
+        if resp is not None:
+            self._node_sessions[node] = resp.get("session_id")
+
+    def unregister_node(self, node: str) -> None:
+        self._post("/node/unregister", {"address": node})
+
+    def send_log(self, time: str, node: str, level: str, message: str) -> None:
+        self._post(
+            "/node-log",
+            {"time": time, "address": node, "level": level, "message": message},
+        )
+
+    def send_local_metric(
+        self, node: str, metric: str, value: float, step: int, round: int
+    ) -> None:
+        self._post(
+            "/node-metric/local",
+            {
+                "address": node,
+                "metric": metric,
+                "value": value,
+                "step": step,
+                "round": round,
+            },
+        )
+
+    def send_global_metric(
+        self, node: str, metric: str, value: float, round: int
+    ) -> None:
+        self._post(
+            "/node-metric/global",
+            {"address": node, "metric": metric, "value": value, "round": round},
+        )
+
+    def send_system_metric(
+        self, node: str, metric: str, value: float, time: str
+    ) -> None:
+        self._post(
+            "/node-metric/system",
+            {"address": node, "metric": metric, "value": value, "time": time},
+        )

@@ -1,0 +1,166 @@
+"""Global configuration.
+
+Mirrors the surface of the reference's ``p2pfl/settings.py`` (class-level
+constants, mutable at runtime before nodes start) while adding the
+profile system the reference scatters across ``utils/utils.py:39`` and
+``examples/mnist.py:43``.  Reference: ``p2pfl/settings.py:28-153``.
+
+Values are read at use-time (not captured at import) everywhere in tpfl,
+so mutating ``Settings.X`` between experiments is safe — this fixes the
+import-capture footgun noted in the reference (``examples/mnist.py:262``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+
+class Settings:
+    """Class-level configuration constants, mutable before node start."""
+
+    # --- gRPC / transport ---
+    GRPC_TIMEOUT: float = 10.0
+    """Timeout (s) for unary RPCs (handshake/disconnect/send)."""
+
+    MAX_MESSAGE_SIZE: int = 1024 * 1024 * 1024
+    """Max gRPC message size (1 GiB) — parity with grpc_server.py:65."""
+
+    # --- logging ---
+    LOG_LEVEL: str = "INFO"
+    LOG_DIR: str = "logs"
+    LOG_FILE_MAX_BYTES: int = 10_000_000
+    LOG_FILE_BACKUP_COUNT: int = 3
+    ASYNC_LOGGER: bool = True
+
+    # --- simulation ---
+    DISABLE_SIMULATION: bool = False
+    """When True, learners run inline instead of in the worker pool."""
+
+    SIM_WORKERS: int = 0
+    """Worker processes for the simulation pool; 0 = use cpu_count."""
+
+    # --- heartbeat ---
+    HEARTBEAT_PERIOD: float = 2.0
+    HEARTBEAT_TIMEOUT: float = 5.0
+
+    # --- gossip (control plane) ---
+    GOSSIP_PERIOD: float = 0.1
+    TTL: int = 10
+    GOSSIP_MESSAGES_PER_PERIOD: int = 100
+    AMOUNT_LAST_MESSAGES_SAVED: int = 100
+
+    # --- gossip (model data plane) ---
+    GOSSIP_MODELS_PERIOD: float = 1.0
+    GOSSIP_MODELS_PER_ROUND: int = 2
+    GOSSIP_EXIT_ON_X_EQUAL_ROUNDS: int = 10
+
+    # --- SSL / mTLS ---
+    USE_SSL: bool = False
+    CA_CRT: str = ""
+    SERVER_CRT: str = ""
+    SERVER_KEY: str = ""
+    CLIENT_CRT: str = ""
+    CLIENT_KEY: str = ""
+
+    # --- FL round protocol ---
+    TRAIN_SET_SIZE: int = 4
+    VOTE_TIMEOUT: float = 60.0
+    AGGREGATION_TIMEOUT: float = 300.0
+    WAIT_HEARTBEATS_CONVERGENCE: float = 0.2
+
+    # --- observability ---
+    RESOURCE_MONITOR_PERIOD: float = 1.0
+
+    # --- determinism / TPU ---
+    SEED: int | None = None
+    """Global seed for reproducible experiments (fork feature)."""
+
+    DEFAULT_DTYPE: str = "float32"
+    """Parameter dtype; compute may run bfloat16 on TPU."""
+
+    EXACT_AGGREGATION: bool = True
+    """When all train-set nodes share one process/mesh, replace
+    gossip-until-converged with an exact on-device mean (see
+    tpfl.parallel). Cross-host gossip still applies between processes."""
+
+    @classmethod
+    def set_test_settings(cls) -> None:
+        """Aggressive timings for tests — parity with utils/utils.py:39-57."""
+        cls.GRPC_TIMEOUT = 0.5
+        cls.HEARTBEAT_PERIOD = 0.5
+        cls.HEARTBEAT_TIMEOUT = 2.0
+        cls.GOSSIP_PERIOD = 0.0
+        cls.TTL = 10
+        cls.GOSSIP_MESSAGES_PER_PERIOD = 100
+        cls.AMOUNT_LAST_MESSAGES_SAVED = 100
+        cls.GOSSIP_MODELS_PERIOD = 0.1
+        cls.GOSSIP_MODELS_PER_ROUND = 4
+        cls.GOSSIP_EXIT_ON_X_EQUAL_ROUNDS = 10
+        cls.TRAIN_SET_SIZE = 4
+        cls.VOTE_TIMEOUT = 10.0
+        cls.AGGREGATION_TIMEOUT = 10.0
+        cls.WAIT_HEARTBEATS_CONVERGENCE = 0.2
+        cls.LOG_LEVEL = "DEBUG"
+        cls.ASYNC_LOGGER = False
+
+    @classmethod
+    def set_standalone_settings(cls) -> None:
+        """Single-host many-node simulation profile — parity with
+        examples/mnist.py:43-70."""
+        cls.GRPC_TIMEOUT = 2.0
+        cls.HEARTBEAT_PERIOD = 10.0
+        cls.HEARTBEAT_TIMEOUT = 45.0
+        cls.GOSSIP_PERIOD = 1.0
+        cls.TTL = 40
+        cls.GOSSIP_MESSAGES_PER_PERIOD = 9999999
+        cls.AMOUNT_LAST_MESSAGES_SAVED = 9999999
+        cls.GOSSIP_MODELS_PERIOD = 1.0
+        cls.GOSSIP_MODELS_PER_ROUND = 4
+        cls.GOSSIP_EXIT_ON_X_EQUAL_ROUNDS = 30
+        cls.VOTE_TIMEOUT = 1200.0
+        cls.AGGREGATION_TIMEOUT = 1200.0
+        cls.WAIT_HEARTBEATS_CONVERGENCE = 4.0
+        cls.LOG_LEVEL = "INFO"
+
+    @classmethod
+    def snapshot(cls) -> dict[str, Any]:
+        """Capture all settings (for restoring after tests)."""
+        return {
+            k: getattr(cls, k)
+            for k in dir(cls)
+            if k.isupper() and not k.startswith("_")
+        }
+
+    @classmethod
+    def restore(cls, snap: dict[str, Any]) -> None:
+        for k, v in snap.items():
+            setattr(cls, k, v)
+
+    @classmethod
+    def from_env(cls) -> None:
+        """Override any setting from a ``TPFL_<NAME>`` environment variable."""
+        for k in list(cls.snapshot()):
+            env = os.environ.get(f"TPFL_{k}")
+            if env is None:
+                continue
+            cur = getattr(cls, k)
+            if isinstance(cur, bool):
+                setattr(cls, k, env.lower() in ("1", "true", "yes"))
+            elif isinstance(cur, int):
+                setattr(cls, k, int(env))
+            elif isinstance(cur, float):
+                setattr(cls, k, float(env))
+            elif cur is None:
+                # None-default settings (e.g. SEED): parse numerically when
+                # possible so TPFL_SEED=42 yields an int, not a string.
+                for parse in (int, float):
+                    try:
+                        setattr(cls, k, parse(env))
+                        break
+                    except ValueError:
+                        continue
+                else:
+                    setattr(cls, k, env)
+            else:
+                setattr(cls, k, env)
