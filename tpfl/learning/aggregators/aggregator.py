@@ -78,7 +78,10 @@ class Aggregator(ABC):
         with self._lock:
             self._train_set = list(nodes)
             self._models = []
-        self._finish_aggregation_event.clear()
+            # Clear under the lock: a model arriving between the train-set
+            # assignment and the clear would otherwise see the event still
+            # set in add_model and be dropped at round start.
+            self._finish_aggregation_event.clear()
 
     def clear(self) -> None:
         """End a round (reference RoundFinishedStage calls this)."""
@@ -108,12 +111,12 @@ class Aggregator(ABC):
         except ValueError:
             logger.debug(self.node_name, "Dropping model with no contributors")
             return []
-        if self._finish_aggregation_event.is_set():
-            logger.debug(
-                self.node_name, "Dropping model: no aggregation in progress"
-            )
-            return []
         with self._lock:
+            if self._finish_aggregation_event.is_set():
+                logger.debug(
+                    self.node_name, "Dropping model: no aggregation in progress"
+                )
+                return []
             if not self._train_set:
                 logger.debug(self.node_name, "Dropping model: no train set")
                 return []

@@ -18,7 +18,12 @@ from tpfl.learning.model import TpflModel
 @jax.jit
 def _weighted_mean(stacked, weights):
     """sum_i w_i * x_i / sum_i w_i along the leading node axis."""
-    norm = weights / jnp.sum(weights)
+    total = jnp.sum(weights)
+    # All-zero sample counts (empty partitions) fall back to a uniform
+    # mean instead of poisoning every parameter with NaN.
+    norm = jnp.where(
+        total > 0, weights / jnp.maximum(total, 1.0), 1.0 / weights.shape[0]
+    )
 
     def leaf_mean(x):
         w = norm.astype(jnp.promote_types(x.dtype, jnp.float32))

@@ -1,0 +1,37 @@
+"""Dataset layer: framework-neutral data wrapper + partitioning.
+
+TPU-native redesign of the reference's ``p2pfl/learning/dataset/``
+(``p2pfl_dataset.py:55``, ``partition_strategies.py:29``): same public
+surface (constructors, ``generate_partitions``, export strategies) but
+batches export directly as jax arrays — no torch ``DataLoader`` detour
+(the reference's flax path routes through torch, ``flax_dataset.py:55-67``).
+"""
+
+from tpfl.learning.dataset.export import DataExportStrategy, JaxExportStrategy
+from tpfl.learning.dataset.partition_strategies import (
+    DataPartitionStrategy,
+    DirichletPartitionStrategy,
+    LabelSkewedPartitionStrategy,
+    PercentageBasedNonIIDPartitionStrategy,
+    RandomIIDPartitionStrategy,
+)
+from tpfl.learning.dataset.synthetic import (
+    synthetic_cifar10,
+    synthetic_classification,
+    synthetic_mnist,
+)
+from tpfl.learning.dataset.tpfl_dataset import TpflDataset
+
+__all__ = [
+    "TpflDataset",
+    "DataExportStrategy",
+    "JaxExportStrategy",
+    "DataPartitionStrategy",
+    "RandomIIDPartitionStrategy",
+    "LabelSkewedPartitionStrategy",
+    "DirichletPartitionStrategy",
+    "PercentageBasedNonIIDPartitionStrategy",
+    "synthetic_mnist",
+    "synthetic_cifar10",
+    "synthetic_classification",
+]

@@ -1,0 +1,212 @@
+"""Framework-neutral dataset container.
+
+Capability parity with the reference's ``P2PFLDataset``
+(``p2pfl/learning/dataset/p2pfl_dataset.py:55-342``): wraps a Hugging
+Face ``Dataset``/``DatasetDict``, exposes train/test splits, constructor
+helpers (``from_csv/json/parquet/pandas/huggingface/generator``), index
+access, and ``generate_partitions`` via pluggable strategies.
+
+TPU-native differences: ``export`` produces jax-ready numpy/jnp batches
+(see :mod:`tpfl.learning.dataset.export`), and partition views stay lazy
+``Dataset.select`` index views so a 100-node split of one array costs no
+copies.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Union
+
+import numpy as np
+
+from datasets import Dataset, DatasetDict, load_dataset
+
+
+class TpflDataset:
+    """Train/test dataset wrapper with partitioning support.
+
+    Args:
+        data: a HF ``Dataset`` (will be split), ``DatasetDict`` (must
+            contain ``train_split_name``/``test_split_name``), or a plain
+            dict of column -> array (treated as one dataset and split).
+        train_split_name: split key holding training data.
+        test_split_name: split key holding test data.
+        batch_size: default export batch size.
+    """
+
+    def __init__(
+        self,
+        data: Union[Dataset, DatasetDict, dict],
+        train_split_name: str = "train",
+        test_split_name: str = "test",
+        batch_size: int = 64,
+    ) -> None:
+        if isinstance(data, dict) and not isinstance(data, DatasetDict):
+            data = Dataset.from_dict(data)
+        self._data: Union[Dataset, DatasetDict] = data
+        self._train_split_name = train_split_name
+        self._test_split_name = test_split_name
+        self.batch_size = batch_size
+
+    # --- constructors (parity p2pfl_dataset.py:250-342) ---
+
+    @classmethod
+    def from_huggingface(cls, dataset_name: str, **kwargs: Any) -> "TpflDataset":
+        return cls(load_dataset(dataset_name, **kwargs))
+
+    @classmethod
+    def from_csv(cls, path: str, **kwargs: Any) -> "TpflDataset":
+        return cls(load_dataset("csv", data_files=path, **kwargs))
+
+    @classmethod
+    def from_json(cls, path: str, **kwargs: Any) -> "TpflDataset":
+        return cls(load_dataset("json", data_files=path, **kwargs))
+
+    @classmethod
+    def from_parquet(cls, path: str, **kwargs: Any) -> "TpflDataset":
+        return cls(load_dataset("parquet", data_files=path, **kwargs))
+
+    @classmethod
+    def from_pandas(cls, df: Any, **kwargs: Any) -> "TpflDataset":
+        return cls(Dataset.from_pandas(df, **kwargs))
+
+    @classmethod
+    def from_generator(cls, generator: Callable, **kwargs: Any) -> "TpflDataset":
+        return cls(Dataset.from_generator(generator, **kwargs))
+
+    @classmethod
+    def from_arrays(
+        cls,
+        x_train: np.ndarray,
+        y_train: np.ndarray,
+        x_test: np.ndarray,
+        y_test: np.ndarray,
+        x_name: str = "image",
+        y_name: str = "label",
+    ) -> "TpflDataset":
+        """In-memory constructor (no HF hub round-trip) — the normal path
+        for synthetic/benchmark data."""
+        return cls(
+            DatasetDict(
+                {
+                    "train": Dataset.from_dict(
+                        {x_name: list(x_train), y_name: list(y_train)}
+                    ),
+                    "test": Dataset.from_dict(
+                        {x_name: list(x_test), y_name: list(y_test)}
+                    ),
+                }
+            )
+        )
+
+    # --- split handling ---
+
+    def _require_dict(self) -> DatasetDict:
+        if not isinstance(self._data, DatasetDict):
+            raise ValueError(
+                "Dataset has no train/test splits yet — call set_split"
+                " or construct with a DatasetDict"
+            )
+        return self._data
+
+    def set_split(self, train_fraction: float = 0.8, seed: int = 666) -> None:
+        """Split a flat dataset into train/test (p2pfl_dataset.py uses a
+        similar lazy split seam)."""
+        if isinstance(self._data, DatasetDict):
+            return
+        split = self._data.train_test_split(
+            test_size=1.0 - train_fraction, seed=seed
+        )
+        self._data = DatasetDict(
+            {
+                self._train_split_name: split["train"],
+                self._test_split_name: split["test"],
+            }
+        )
+
+    def get_split(self, train: bool = True) -> Dataset:
+        if isinstance(self._data, Dataset):
+            self.set_split()
+        d = self._require_dict()
+        name = self._train_split_name if train else self._test_split_name
+        if name not in d:
+            raise KeyError(f"Split {name!r} not in dataset (has {list(d)})")
+        return d[name]
+
+    def num_samples(self, train: bool = True) -> int:
+        return len(self.get_split(train))
+
+    def get(self, idx: int, train: bool = True) -> dict[str, Any]:
+        """Single-example access (parity p2pfl_dataset.py item API)."""
+        return self.get_split(train)[idx]
+
+    # --- partitioning (parity p2pfl_dataset.py:187-222) ---
+
+    def generate_partitions(
+        self,
+        num_partitions: int,
+        strategy: Any,
+        seed: int = 666,
+        label_tag: str = "label",
+        **kwargs: Any,
+    ) -> list["TpflDataset"]:
+        """Split into ``num_partitions`` datasets by index selection.
+
+        ``strategy`` is a :class:`DataPartitionStrategy` subclass (or
+        instance); both train and test splits are partitioned with the
+        same strategy/seed.
+        """
+        train_idx, test_idx = strategy.generate_partitions(
+            self.get_split(True),
+            self.get_split(False),
+            num_partitions,
+            seed=seed,
+            label_tag=label_tag,
+            **kwargs,
+        )
+        out = []
+        for i in range(num_partitions):
+            out.append(
+                TpflDataset(
+                    DatasetDict(
+                        {
+                            self._train_split_name: self.get_split(True).select(
+                                train_idx[i]
+                            ),
+                            self._test_split_name: self.get_split(False).select(
+                                test_idx[i]
+                            ),
+                        }
+                    ),
+                    train_split_name=self._train_split_name,
+                    test_split_name=self._test_split_name,
+                    batch_size=self.batch_size,
+                )
+            )
+        return out
+
+    # --- export (parity p2pfl_dataset.py:224-248) ---
+
+    def export(
+        self,
+        strategy: Optional[Any] = None,
+        train: bool = True,
+        **kwargs: Any,
+    ) -> Any:
+        """Export via a DataExportStrategy (default: jax arrays)."""
+        from tpfl.learning.dataset.export import JaxExportStrategy
+
+        strategy = strategy or JaxExportStrategy
+        return strategy.export(
+            self.get_split(train),
+            batch_size=kwargs.pop("batch_size", self.batch_size),
+            **kwargs,
+        )
+
+    def __repr__(self) -> str:
+        try:
+            return (
+                f"TpflDataset(train={self.num_samples(True)},"
+                f" test={self.num_samples(False)})"
+            )
+        except (ValueError, KeyError):
+            return f"TpflDataset(unsplit, n={len(self._data)})"

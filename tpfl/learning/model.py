@@ -81,7 +81,7 @@ class TpflModel:
         """Accepts a TpflModel, a pytree, a flat leaf list, or encoded
         bytes (reference learner.py:66-80 seam)."""
         if isinstance(params, TpflModel):
-            self._params = params.get_parameters()
+            self._check_and_set(params.get_parameters())
             return
         if isinstance(params, bytes):
             decoded, contribs, n, info = serialization.decode_model_payload(params)

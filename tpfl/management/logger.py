@@ -126,6 +126,20 @@ class TpflLogger:
 
     # --- metrics (routing: reference logger.py:266-308) ---
 
+    def resolve_experiment(
+        self, addr: str, round: Optional[int]
+    ) -> tuple[str, Optional[int]]:
+        """(exp_name, round) for a node, filling round from its running
+        experiment when not given. Shared by base and web decorators."""
+        info = self._nodes.get(addr)
+        exp_name = "unknown-exp"
+        if info is not None and info.get("experiment") is not None:
+            exp = info["experiment"]
+            exp_name = exp.exp_name
+            if round is None:
+                round = exp.round
+        return exp_name, round
+
     def log_metric(
         self,
         addr: str,
@@ -134,13 +148,7 @@ class TpflLogger:
         step: Optional[int] = None,
         round: Optional[int] = None,
     ) -> None:
-        info = self._nodes.get(addr)
-        exp_name = "unknown-exp"
-        if info is not None and info.get("experiment") is not None:
-            exp = info["experiment"]
-            exp_name = exp.exp_name
-            if round is None:
-                round = exp.round
+        exp_name, round = self.resolve_experiment(addr, round)
         if round is None:
             raise ValueError(f"No round info for node {addr}; pass round=")
         if step is None:
@@ -211,23 +219,44 @@ class LoggerDecorator:
         return getattr(self._inner, name)
 
 
+class _LazyFileHandler(logging.Handler):
+    """Creates Settings.LOG_DIR and the rotating file only on the first
+    emitted record — importing tpfl never touches the filesystem, and
+    Settings.FILE_LOGGER / LOG_DIR are read at use-time, not import."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._real: Optional[logging.handlers.RotatingFileHandler] = None
+
+    def emit(self, record: logging.LogRecord) -> None:
+        if not Settings.FILE_LOGGER:
+            return
+        if self._real is None:
+            os.makedirs(Settings.LOG_DIR, exist_ok=True)
+            self._real = logging.handlers.RotatingFileHandler(
+                os.path.join(
+                    Settings.LOG_DIR,
+                    f"tpfl-{datetime.datetime.now():%Y%m%d-%H%M%S}.log",
+                ),
+                maxBytes=Settings.LOG_FILE_MAX_BYTES,
+                backupCount=Settings.LOG_FILE_BACKUP_COUNT,
+            )
+            self._real.setFormatter(FileFormatter())
+        self._real.emit(record)
+
+    def close(self) -> None:
+        if self._real is not None:
+            self._real.close()
+        super().close()
+
+
 class FileLogger(LoggerDecorator):
     """Rotating file handler in Settings.LOG_DIR (reference
     file_logger.py:30)."""
 
     def __init__(self, inner) -> None:
         super().__init__(inner)
-        os.makedirs(Settings.LOG_DIR, exist_ok=True)
-        handler = logging.handlers.RotatingFileHandler(
-            os.path.join(
-                Settings.LOG_DIR,
-                f"tpfl-{datetime.datetime.now():%Y%m%d-%H%M%S}.log",
-            ),
-            maxBytes=Settings.LOG_FILE_MAX_BYTES,
-            backupCount=Settings.LOG_FILE_BACKUP_COUNT,
-        )
-        handler.setFormatter(FileFormatter())
-        inner._logger.addHandler(handler)
+        inner._logger.addHandler(_LazyFileHandler())
 
 
 class AsyncLogger(LoggerDecorator):
@@ -324,12 +353,8 @@ class WebLogger(LoggerDecorator):
         self.log(logging.CRITICAL, node, message)
 
     def log_metric(self, addr, metric, value, step=None, round=None) -> None:
-        if round is None:
-            # Resolve from the node's experiment so the dashboard never
-            # receives round=null.
-            info = self.get_nodes().get(addr)
-            if info is not None and info.get("experiment") is not None:
-                round = info["experiment"].round
+        # Resolve so the dashboard never receives round=null.
+        _, round = self.resolve_experiment(addr, round)
         self._inner.log_metric(addr, metric, value, step=step, round=round)
         if self._web is not None:
             if step is None:
@@ -345,7 +370,11 @@ class WebLogger(LoggerDecorator):
 
 
 def _build_logger() -> WebLogger:
-    base: Any = TpflLogger()
+    # WebLogger(AsyncLogger(FileLogger(TpflLogger))) — reference
+    # logger/__init__.py:29-35. FileLogger attaches its handler before
+    # AsyncLogger moves all handlers behind the queue, so file writes
+    # never block protocol threads.
+    base: Any = FileLogger(TpflLogger())
     if Settings.ASYNC_LOGGER:
         base = AsyncLogger(base)
     return WebLogger(base)

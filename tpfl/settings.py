@@ -28,6 +28,7 @@ class Settings:
 
     # --- logging ---
     LOG_LEVEL: str = "INFO"
+    FILE_LOGGER: bool = True
     LOG_DIR: str = "logs"
     LOG_FILE_MAX_BYTES: int = 10_000_000
     LOG_FILE_BACKUP_COUNT: int = 3
@@ -103,6 +104,7 @@ class Settings:
         cls.WAIT_HEARTBEATS_CONVERGENCE = 0.2
         cls.LOG_LEVEL = "DEBUG"
         cls.ASYNC_LOGGER = False
+        cls.FILE_LOGGER = False
 
     @classmethod
     def set_standalone_settings(cls) -> None:
