@@ -4,13 +4,20 @@ aggressive test settings profile (reference utils/utils.py:39-57)."""
 
 import os
 
-# Must happen before jax is imported anywhere.
+# Must happen before any jax backend is initialized. The env image's
+# sitecustomize imports jax and registers the TPU plugin at interpreter
+# start, so mutating JAX_PLATFORMS here is too late — go through
+# jax.config instead (backends are still uninitialized at conftest time).
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

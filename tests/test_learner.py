@@ -1,0 +1,192 @@
+"""Learner tests — mirrors the reference's ``frameworks_test.py``
+(params round-trip, short real fit asserting loss decreases) plus the
+SCAFFOLD callback contract used by ``scaffold_test.py``."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpfl.learning.aggregators import FedAvg, Scaffold
+from tpfl.learning.callbacks import CallbackFactory, ScaffoldCallback
+from tpfl.learning.dataset import synthetic_mnist
+from tpfl.learning.jax_learner import JaxLearner
+from tpfl.models import create_model
+
+
+@pytest.fixture(scope="module")
+def mnist():
+    return synthetic_mnist(n_train=256, n_test=128, seed=1)
+
+
+def make_learner(mnist, aggregator=None, addr="node-a", lr=1e-2):
+    model = create_model("mlp", (28, 28), seed=0, hidden_sizes=(32,))
+    return JaxLearner(
+        model=model,
+        data=mnist,
+        addr=addr,
+        aggregator=aggregator,
+        learning_rate=lr,
+        batch_size=32,
+    )
+
+
+def test_fit_decreases_loss_and_sets_metadata(mnist):
+    learner = make_learner(mnist)
+    before = learner.evaluate()
+    learner.set_epochs(3)
+    model = learner.fit()
+    after = learner.evaluate()
+    assert after["test_loss"] < before["test_loss"]
+    assert model.get_contributors() == ["node-a"]
+    assert model.get_num_samples() == 256
+
+
+def test_evaluate_counts_every_sample_with_ragged_tail():
+    from tpfl.learning.dataset import synthetic_mnist as synth
+
+    ds = synth(n_train=64, n_test=100, seed=2)  # 100 % 32 != 0
+    learner = JaxLearner(
+        model=create_model("mlp", (28, 28), seed=0, hidden_sizes=(16,)),
+        data=ds,
+        batch_size=32,
+    )
+    learner.evaluate()
+    # Re-drive the compiled eval with the same padding evaluate() builds
+    # and check the confusion matrix covers all 100 samples, not 96.
+    batches = ds.export(batch_size=32, train=False, drop_remainder=False)
+    x, y = batches.x, batches.y
+    pad = 4 * 32 - len(x)
+    mask = np.concatenate([np.ones(len(x), np.int32), np.zeros(pad, np.int32)])
+    x = np.concatenate([x, np.zeros((pad, *x.shape[1:]), x.dtype)])
+    y = np.concatenate([y, np.zeros(pad, y.dtype)])
+    _, cm = learner._eval_fn(
+        learner.get_model().get_parameters(),
+        {},
+        jnp.asarray(x.reshape(4, 32, 28, 28)),
+        jnp.asarray(y.reshape(4, 32)),
+        jnp.asarray(mask.reshape(4, 32)),
+    )
+    assert int(np.asarray(cm).sum()) == 100
+
+
+def test_evaluate_metric_keys(mnist):
+    m = make_learner(mnist).evaluate()
+    assert set(m) == {
+        "test_loss",
+        "test_metric",
+        "test_precision",
+        "test_recall",
+        "test_f1",
+    }
+    assert 0.0 <= m["test_metric"] <= 1.0
+    assert 0.0 <= m["test_f1"] <= 1.0
+
+
+def test_fit_reproducible_with_same_addr(mnist):
+    a = make_learner(mnist, addr="node-x")
+    b = make_learner(mnist, addr="node-x")
+    for ln in (a, b):
+        ln.set_epochs(1)
+        ln.fit()
+    pa = jax.tree_util.tree_leaves(a.get_model().get_parameters())
+    pb = jax.tree_util.tree_leaves(b.get_model().get_parameters())
+    for x, y in zip(pa, pb):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=1e-6)
+
+
+def test_fit_differs_across_addrs(mnist):
+    a = make_learner(mnist, addr="node-x")
+    b = make_learner(mnist, addr="node-y")
+    for ln in (a, b):
+        ln.set_epochs(1)
+        ln.fit()
+    pa = jax.tree_util.tree_leaves(a.get_model().get_parameters())
+    pb = jax.tree_util.tree_leaves(b.get_model().get_parameters())
+    assert any(
+        not np.allclose(np.asarray(x), np.asarray(y)) for x, y in zip(pa, pb)
+    )
+
+
+def test_zero_epochs_leaves_model_untouched_with_zero_weight(mnist):
+    learner = make_learner(mnist)
+    start = learner.get_model().get_parameters()
+    start_leaves = [np.asarray(x).copy() for x in jax.tree_util.tree_leaves(start)]
+    learner.set_epochs(0)
+    model = learner.fit()
+    end_leaves = jax.tree_util.tree_leaves(learner.get_model().get_parameters())
+    for s, e in zip(start_leaves, end_leaves):
+        np.testing.assert_array_equal(s, np.asarray(e))
+    assert model.get_num_samples() == 0  # no FedAvg weight for no training
+
+
+def test_interrupt_fit_stops_after_current_epoch(mnist):
+    learner = make_learner(mnist)
+    learner.set_epochs(5)
+    orig = learner._build_train_epoch()
+    calls = []
+
+    def wrapper(state, xs, ys, corr):
+        calls.append(1)
+        learner.interrupt_fit()  # lands mid-fit, checked next epoch
+        return orig(state, xs, ys, corr)
+
+    learner._train_epoch_fn = wrapper
+    model = learner.fit()
+    assert len(calls) == 1
+    assert model.get_num_samples() == 256  # the completed epoch counts
+
+
+def test_scaffold_callback_roundtrip(mnist):
+    agg = Scaffold()
+    learner = make_learner(mnist, aggregator=agg)
+    assert [cb.get_name() for cb in learner.callbacks] == ["scaffold"]
+    learner.set_epochs(1)
+    model = learner.fit()
+    info = model.get_info("scaffold")
+    assert "delta_y_i" in info and "delta_c_i" in info
+    # delta_y must equal final - initial params.
+    dy = jax.tree_util.tree_leaves(info["delta_y_i"])
+    assert all(np.isfinite(np.asarray(x)).all() for x in dy)
+
+    # Aggregator consumes it and emits global_c.
+    agg.set_nodes_to_aggregate(["node-a"])
+    agg.add_model(model)
+    out = agg.wait_and_get_aggregation(timeout=1)
+    assert "global_c" in out.get_info("scaffold")
+
+    # Learner picks global_c back up.
+    learner.set_model(out)
+    assert learner.callbacks[0].get_info().get("global_c") is not None
+
+
+def test_scaffold_correction_is_applied(mnist):
+    cb = ScaffoldCallback()
+    params = {"w": jnp.ones((2, 2))}
+    cb.on_fit_start(params, 0.1)
+    cb.set_info(
+        {"global_c": {"w": jnp.full((2, 2), 3.0)}}
+    )
+    cb.c_i = {"w": jnp.full((2, 2), 1.0)}
+    corr = cb.grad_correction(params)
+    np.testing.assert_allclose(np.asarray(corr["w"]), 2.0)
+
+
+def test_callback_factory_unknown_name():
+    with pytest.raises(KeyError):
+        CallbackFactory.create(["nope"])
+
+
+def test_fedavg_of_trained_learners_keeps_shapes(mnist):
+    la = make_learner(mnist, addr="a")
+    lb = make_learner(mnist, addr="b")
+    for ln in (la, lb):
+        ln.set_epochs(1)
+        ln.fit()
+    agg = FedAvg()
+    agg.set_nodes_to_aggregate(["a", "b"])
+    agg.add_model(la.get_model())
+    agg.add_model(lb.get_model())
+    merged = agg.wait_and_get_aggregation(timeout=1)
+    assert merged.get_num_samples() == 512
+    la.set_model(merged)  # shapes still match

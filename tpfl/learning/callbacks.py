@@ -1,0 +1,139 @@
+"""Learner callbacks + factory.
+
+Parity with the reference's callback system
+(``learning/frameworks/callback.py``, ``callback_factory.py:32-110``):
+aggregators declare required callbacks by name
+(``Aggregator.get_required_callbacks``), the factory instantiates them,
+and callback state rides between learner and aggregator inside
+``TpflModel.additional_info``.
+
+TPU-native difference: instead of torch-style gradient hooks mutating
+``.grad`` (reference ``pytorch/callbacks/scaffold_callback.py:90-110``),
+a callback contributes a **gradient-correction pytree** that the jitted
+train step adds to every gradient — the correction is a traced input, so
+one compiled program serves corrected and uncorrected training.
+"""
+
+from __future__ import annotations
+
+from abc import ABC
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class TpflCallback(ABC):
+    """Base callback (reference callback.py:24). Subclasses override the
+    hooks they need; all state they want shipped to the aggregator goes
+    through ``get_info``/``set_info``."""
+
+    name: str = "base"
+
+    def __init__(self) -> None:
+        self._info: dict[str, Any] = {}
+
+    def get_name(self) -> str:
+        return self.name
+
+    def get_info(self) -> dict[str, Any]:
+        return self._info
+
+    def set_info(self, info: dict[str, Any]) -> None:
+        self._info = dict(info)
+
+    # --- learner hooks ---
+
+    def on_fit_start(self, params: Any, learning_rate: float) -> None:
+        """Called with round-start parameters before the first step."""
+
+    def grad_correction(self, params: Any) -> Optional[Any]:
+        """Pytree added to every gradient inside the jitted step, or
+        None for no correction."""
+        return None
+
+    def on_fit_end(
+        self,
+        initial_params: Any,
+        final_params: Any,
+        num_steps: int,
+        learning_rate: float,
+    ) -> None:
+        """Called after the last step with start/end parameters."""
+
+
+class ScaffoldCallback(TpflCallback):
+    """Client-side SCAFFOLD (Karimireddy et al. 2019; reference
+    ``pytorch/callbacks/scaffold_callback.py:32-140``).
+
+    Receives the global control variate ``c`` from the aggregator via
+    ``set_info({"global_c": ...})``; corrects every gradient by
+    ``c - c_i``; after local training updates its own variate with
+    option II of the paper and ships ``delta_y_i`` / ``delta_c_i``.
+    """
+
+    name = "scaffold"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.c_i: Optional[Any] = None  # local control variate
+
+    def on_fit_start(self, params: Any, learning_rate: float) -> None:
+        if self.c_i is None:
+            self.c_i = jax.tree_util.tree_map(jnp.zeros_like, params)
+        if self._info.get("global_c") is None:
+            self._info["global_c"] = jax.tree_util.tree_map(
+                jnp.zeros_like, params
+            )
+
+    def grad_correction(self, params: Any) -> Any:
+        return jax.tree_util.tree_map(
+            lambda c, ci: (c - ci).astype(c.dtype),
+            self._info["global_c"],
+            self.c_i,
+        )
+
+    def on_fit_end(
+        self,
+        initial_params: Any,
+        final_params: Any,
+        num_steps: int,
+        learning_rate: float,
+    ) -> None:
+        c = self._info["global_c"]
+        delta_y = jax.tree_util.tree_map(
+            lambda y, x: y - x, final_params, initial_params
+        )
+        # Option II: c_i+ = c_i - c + (x - y_i) / (K * lr)
+        scale = 1.0 / max(num_steps * learning_rate, 1e-12)
+        new_c_i = jax.tree_util.tree_map(
+            lambda ci, cg, dy: ci - cg - scale * dy, self.c_i, c, delta_y
+        )
+        delta_c = jax.tree_util.tree_map(lambda n, o: n - o, new_c_i, self.c_i)
+        self.c_i = new_c_i
+        self._info["delta_y_i"] = delta_y
+        self._info["delta_c_i"] = delta_c
+
+
+class CallbackFactory:
+    """Name → callback class registry (reference callback_factory.py).
+    Single-framework (everything is jax), so keys are plain names."""
+
+    _registry: dict[str, type[TpflCallback]] = {}
+
+    @classmethod
+    def register(cls, callback_cls: type[TpflCallback]) -> type[TpflCallback]:
+        cls._registry[callback_cls.name] = callback_cls
+        return callback_cls
+
+    @classmethod
+    def create(cls, names: list[str]) -> list[TpflCallback]:
+        missing = [n for n in names if n not in cls._registry]
+        if missing:
+            raise KeyError(
+                f"Unknown callbacks {missing}; registered: {sorted(cls._registry)}"
+            )
+        return [cls._registry[n]() for n in names]
+
+
+CallbackFactory.register(ScaffoldCallback)

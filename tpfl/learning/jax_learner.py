@@ -1,0 +1,331 @@
+"""JaxLearner — jitted local training and evaluation.
+
+Replaces the reference's three framework learners (LightningLearner
+``lightning_learner.py:43``, KerasLearner ``keras_learner.py:36``, and
+the un-jitted per-sample FlaxLearner ``flax_learner.py:40,93-104``) with
+one TPU-native learner:
+
+- the whole local epoch is ONE compiled XLA program: ``lax.scan`` over a
+  stacked [n_batches, batch, ...] array, donated train state, bfloat16
+  compute via the model zoo;
+- evaluation is a jitted confusion-matrix accumulation; accuracy / macro
+  F1 / precision / recall all derive from it (the fork's extended
+  metrics, ``mlp_pytorch.txt:25-40``);
+- gradient corrections (SCAFFOLD) enter as a traced pytree input, so
+  corrected and plain training share one compiled program;
+- interruption (reference ``interrupt_fit``, barely implemented there)
+  is a host-side check between epochs;
+- seeding: data order and init derive from (Settings.SEED, node addr,
+  round, epoch) — the fork's reproducibility requirement
+  (exp_SAVE3.txt:116-185).
+"""
+
+from __future__ import annotations
+
+import threading
+import zlib
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax.training import train_state
+
+from tpfl.learning.dataset.tpfl_dataset import TpflDataset
+from tpfl.learning.learner import Learner
+from tpfl.learning.model import TpflModel
+from tpfl.management.logger import logger
+from tpfl.settings import Settings
+
+
+class TrainState(train_state.TrainState):
+    """TrainState + mutable collections (batch_stats for ResNet)."""
+
+    aux_state: Any = None
+
+
+def _addr_seed(addr: str) -> int:
+    """Stable per-node seed component (crc32 keeps it deterministic
+    across processes, unlike hash())."""
+    return zlib.crc32(addr.encode())
+
+
+def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+
+
+class JaxLearner(Learner):
+    """Jitted flax/optax learner.
+
+    Args:
+        model: TpflModel wrapping a flax module + params.
+        data: local dataset partition.
+        addr: node address (metrics + seeding).
+        aggregator: used only to build required callbacks.
+        learning_rate / optimizer_factory: optax config; the factory
+            receives the learning rate (default: adam).
+        batch_size: training batch size (eval uses the same).
+        loss_fn: (logits, labels) -> scalar.
+    """
+
+    def __init__(
+        self,
+        model: Optional[TpflModel] = None,
+        data: Optional[TpflDataset] = None,
+        addr: str = "unknown-node",
+        aggregator: Optional[Any] = None,
+        learning_rate: float = 1e-3,
+        optimizer_factory: Optional[Callable[[float], optax.GradientTransformation]] = None,
+        batch_size: int = 64,
+        loss_fn: Callable = cross_entropy_loss,
+    ) -> None:
+        super().__init__(model, data, addr, aggregator)
+        self.learning_rate = float(learning_rate)
+        self._optimizer_factory = optimizer_factory or (lambda lr: optax.adam(lr))
+        self.batch_size = int(batch_size)
+        self._loss_fn = loss_fn
+        self._interrupt = threading.Event()
+        self._round_counter = 0  # advances every fit() for shuffle seeding
+        # One cache per learner: jitted fns close over the module.
+        self._train_epoch_fn: Optional[Callable] = None
+        self._eval_fn: Optional[Callable] = None
+
+    # --- jitted program builders ---
+
+    def _module(self) -> Any:
+        mod = self.get_model().module
+        if mod is None:
+            raise ValueError("TpflModel has no flax module attached")
+        return mod
+
+    def _has_aux(self) -> bool:
+        return bool(self.get_model().aux_state)
+
+    def _build_train_epoch(self) -> Callable:
+        module = self._module()
+        loss_fn = self._loss_fn
+        has_aux = self._has_aux()
+
+        def apply(params, aux, x, train):
+            variables = {"params": params, **(aux or {})}
+            if has_aux:
+                logits, updates = module.apply(
+                    variables, x, train=train, mutable=list(aux.keys())
+                )
+                return logits, updates
+            return module.apply(variables, x, train=train), aux
+
+        def step(state: TrainState, batch, correction):
+            x, y = batch
+
+            def loss_of(params):
+                logits, new_aux = apply(params, state.aux_state, x, True)
+                return loss_fn(logits, y), (logits, new_aux)
+
+            (loss, (logits, new_aux)), grads = jax.value_and_grad(
+                loss_of, has_aux=True
+            )(state.params)
+            grads = jax.tree_util.tree_map(
+                lambda g, c: g + c.astype(g.dtype), grads, correction
+            )
+            state = state.apply_gradients(grads=grads)
+            state = state.replace(aux_state=new_aux)
+            acc = jnp.mean(jnp.argmax(logits, -1) == y)
+            return state, (loss, acc)
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def train_epoch(state: TrainState, xs, ys, correction):
+            state, (losses, accs) = jax.lax.scan(
+                lambda s, b: step(s, b, correction), state, (xs, ys)
+            )
+            return state, jnp.mean(losses), jnp.mean(accs)
+
+        return train_epoch
+
+    def _build_eval(self, n_classes: int) -> Callable:
+        """Masked confusion-matrix eval: inputs are padded to full
+        batches and a 0/1 sample mask keeps padding out of every metric,
+        so one compiled shape covers any test-set size."""
+        module = self._module()
+
+        @jax.jit
+        def eval_batches(params, aux, xs, ys, ms):
+            def one(carry, batch):
+                x, y, m = batch
+                variables = {"params": params, **(aux or {})}
+                logits = module.apply(variables, x, train=False)
+                losses = optax.softmax_cross_entropy_with_integer_labels(
+                    logits, y
+                )
+                preds = jnp.argmax(logits, -1)
+                cm = jnp.zeros((n_classes, n_classes), jnp.int32).at[
+                    y, preds
+                ].add(m)
+                loss_sum, cm_sum = carry
+                return (loss_sum + jnp.sum(losses * m), cm_sum + cm), None
+
+            init = (jnp.zeros(()), jnp.zeros((n_classes, n_classes), jnp.int32))
+            (loss_sum, cm), _ = jax.lax.scan(one, init, (xs, ys, ms))
+            total = jnp.maximum(jnp.sum(ms), 1)
+            return loss_sum / total, cm
+
+        return eval_batches
+
+    # --- data ---
+
+    def _stacked(self, train: bool, epoch_seed: int):
+        batches = self.get_data().export(
+            batch_size=self.batch_size, train=train, seed=epoch_seed
+        )
+        return batches
+
+    # --- Learner API ---
+
+    def fit(self) -> TpflModel:
+        """Run ``self.epochs`` local epochs; one XLA program per epoch."""
+        self._interrupt.clear()
+        model = self.get_model()
+        if self._train_epoch_fn is None:
+            self._train_epoch_fn = self._build_train_epoch()
+
+        base_seed = (Settings.SEED or 0) + _addr_seed(self._addr)
+        # Train on a copy: the state is donated to the compiled epoch,
+        # which invalidates its buffers on TPU — the model's own params
+        # must stay readable (gossip threads serve them mid-fit), and
+        # callbacks need the round-start values after training.
+        state = TrainState.create(
+            apply_fn=self._module().apply,
+            params=jax.tree_util.tree_map(jnp.copy, model.get_parameters()),
+            tx=self._optimizer_factory(self.learning_rate),
+            aux_state=jax.tree_util.tree_map(jnp.copy, model.aux_state or {}),
+        )
+        initial_params = model.get_parameters()
+
+        # Callbacks see round-start params; correction is zeros unless a
+        # callback (SCAFFOLD) provides one.
+        for cb in self.callbacks:
+            cb.on_fit_start(initial_params, self.learning_rate)
+        correction = None
+        for cb in self.callbacks:
+            c = cb.grad_correction(initial_params)
+            if c is not None:
+                correction = c
+        if correction is None:
+            correction = jax.tree_util.tree_map(
+                lambda p: jnp.zeros((), p.dtype), initial_params
+            )
+
+        batches = self._stacked(True, base_seed)
+        in_exp = self._in_experiment()
+        n_steps = 0
+        for epoch in range(self.epochs):
+            if self._interrupt.is_set():
+                logger.info(self._addr, f"Training interrupted at epoch {epoch}")
+                break
+            xs, ys = batches.stacked(epoch=self._round_counter * 10_000 + epoch)
+            state, loss, acc = self._train_epoch_fn(
+                state, jnp.asarray(xs), jnp.asarray(ys), correction
+            )
+            n_steps += xs.shape[0]
+            if in_exp:
+                logger.log_metric(
+                    self._addr, "train_loss", float(loss), step=epoch
+                )
+            logger.debug(
+                self._addr,
+                f"epoch {epoch}: loss={float(loss):.4f} acc={float(acc):.4f}",
+            )
+        self._round_counter += 1
+
+        if n_steps == 0:
+            # Interrupted (or epochs=0) before any step: model unchanged,
+            # zero FL weight, and no fabricated callback deltas — a node
+            # that did no training must not move the global control
+            # variates or count in the weighted mean.
+            model.set_contribution([self._addr], 0)
+            return model
+
+        model.set_parameters(state.params)
+        if state.aux_state:
+            model.aux_state = state.aux_state
+        model.set_contribution([self._addr], batches.num_samples)
+        for cb in self.callbacks:
+            cb.on_fit_end(
+                initial_params, state.params, n_steps, self.learning_rate
+            )
+        self.add_callback_info_to_model()
+        return model
+
+    def _in_experiment(self) -> bool:
+        info = logger.get_nodes().get(self._addr)
+        return bool(info and info.get("experiment") is not None)
+
+    def interrupt_fit(self) -> None:
+        self._interrupt.set()
+
+    def evaluate(self) -> dict[str, float]:
+        """Loss + accuracy + macro precision/recall/F1 from one jitted
+        confusion-matrix pass (fork metrics, mlp_pytorch.txt:25-40)."""
+        model = self.get_model()
+        data = self.get_data()
+        if data.num_samples(False) == 0:
+            return {}
+        batches = data.export(
+            batch_size=self.batch_size, train=False, drop_remainder=False
+        )
+        # Pad to full batches with a sample mask so the compiled shape is
+        # independent of the test-set size and no tail sample is dropped.
+        x, y = batches.x, batches.y
+        bs = batches.batch_size
+        n_batches = -(-len(x) // bs)
+        pad = n_batches * bs - len(x)
+        mask = np.concatenate([np.ones(len(x), np.int32), np.zeros(pad, np.int32)])
+        x = np.concatenate([x, np.zeros((pad, *x.shape[1:]), x.dtype)])
+        y = np.concatenate([y, np.zeros(pad, y.dtype)])
+        xs = x.reshape(n_batches, bs, *x.shape[1:])
+        ys = y.reshape(n_batches, bs)
+        ms = mask.reshape(n_batches, bs)
+        if self._eval_fn is None:
+            aux = model.aux_state or {}
+            logits_shape = jax.eval_shape(
+                lambda p, a, xx: self._module().apply(
+                    {"params": p, **a}, xx, train=False
+                ),
+                model.get_parameters(),
+                aux,
+                jnp.zeros(xs.shape[1:], jnp.float32),
+            ).shape
+            self._eval_fn = self._build_eval(int(logits_shape[-1]))
+        loss, cm = self._eval_fn(
+            model.get_parameters(),
+            model.aux_state or {},
+            jnp.asarray(xs),
+            jnp.asarray(ys),
+            jnp.asarray(ms),
+        )
+        cm = np.asarray(cm, np.float64)
+        tp = np.diag(cm)
+        support = cm.sum(axis=1)  # true counts per class
+        predicted = cm.sum(axis=0)
+        present = support > 0
+        with np.errstate(divide="ignore", invalid="ignore"):
+            precision = np.where(predicted > 0, tp / predicted, 0.0)
+            recall = np.where(present, tp / support, 0.0)
+            f1 = np.where(
+                precision + recall > 0,
+                2 * precision * recall / (precision + recall),
+                0.0,
+            )
+        metrics = {
+            "test_loss": float(loss),
+            "test_metric": float(tp.sum() / max(cm.sum(), 1.0)),  # accuracy
+            "test_precision": float(precision[present].mean()),
+            "test_recall": float(recall[present].mean()),
+            "test_f1": float(f1[present].mean()),
+        }
+        if self._in_experiment():
+            for k, v in metrics.items():
+                logger.log_metric(self._addr, k, v)
+        return metrics

@@ -1,0 +1,115 @@
+"""Learner ABC — the local train/eval seam.
+
+Parity with the reference ``p2pfl/learning/frameworks/learner.py:33``:
+
+- ``set_model`` accepting model / flat list / wire bytes  (learner.py:66-80)
+- callback info sync to/from the model                    (learner.py:122-135)
+- abstract ``fit`` / ``interrupt_fit`` / ``evaluate`` /
+  ``get_framework``                                       (learner.py:137-167)
+
+The simulation layer wraps learners (`tpfl.simulation`), and aggregators
+declare which callbacks a learner must run.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Any, Optional, Union
+
+from tpfl.learning.callbacks import CallbackFactory, TpflCallback
+from tpfl.learning.dataset.tpfl_dataset import TpflDataset
+from tpfl.learning.model import TpflModel
+
+
+class Learner(ABC):
+    """Template for local training/evaluation on one node."""
+
+    def __init__(
+        self,
+        model: Optional[TpflModel] = None,
+        data: Optional[TpflDataset] = None,
+        addr: str = "unknown-node",
+        aggregator: Optional[Any] = None,
+    ) -> None:
+        self._model = model
+        self._data = data
+        self._addr = addr
+        self.epochs: int = 1
+        # Build the callbacks the aggregator requires (reference
+        # learner.py:52-53 via CallbackFactory).
+        names = aggregator.get_required_callbacks() if aggregator else []
+        self.callbacks: list[TpflCallback] = CallbackFactory.create(names)
+
+    # --- wiring ---
+
+    def set_addr(self, addr: str) -> None:
+        self._addr = addr
+
+    def get_addr(self) -> str:
+        return self._addr
+
+    def set_model(self, model: Union[TpflModel, list, bytes]) -> None:
+        """Accept a full model, flat param list, or wire bytes
+        (reference learner.py:66-80)."""
+        if isinstance(model, TpflModel):
+            self._model = model
+        else:
+            if self._model is None:
+                raise ValueError("No base model to set parameters into")
+            self._model.set_parameters(model)
+        self.update_callbacks_with_model_info()
+
+    def get_model(self) -> TpflModel:
+        if self._model is None:
+            raise ValueError("Learner has no model")
+        return self._model
+
+    def set_data(self, data: TpflDataset) -> None:
+        self._data = data
+
+    def get_data(self) -> TpflDataset:
+        if self._data is None:
+            raise ValueError("Learner has no data")
+        return self._data
+
+    def set_epochs(self, epochs: int) -> None:
+        self.epochs = int(epochs)
+
+    # --- callback info transport (reference learner.py:122-135) ---
+
+    def update_callbacks_with_model_info(self) -> None:
+        """Push aggregator-sent state (model.additional_info) into the
+        matching callbacks."""
+        if self._model is None:
+            return
+        for cb in self.callbacks:
+            info = self._model.get_info().get(cb.get_name())
+            if info is not None:
+                cb.set_info(info)
+
+    def add_callback_info_to_model(self) -> None:
+        """Collect callback state into the model for the aggregator."""
+        if self._model is None:
+            return
+        for cb in self.callbacks:
+            self._model.add_info(cb.get_name(), cb.get_info())
+
+    # --- abstract (reference learner.py:137-167) ---
+
+    @abstractmethod
+    def fit(self) -> TpflModel:
+        """Train locally for ``self.epochs``; returns the updated model."""
+
+    @abstractmethod
+    def interrupt_fit(self) -> None:
+        """Request an early stop of a running fit."""
+
+    @abstractmethod
+    def evaluate(self) -> dict[str, float]:
+        """Compute eval metrics on the local test split."""
+
+    def get_framework(self) -> str:
+        return "jax"
+
+    def get_num_samples(self) -> int:
+        return self.get_data().num_samples(True)

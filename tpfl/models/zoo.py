@@ -1,0 +1,150 @@
+"""Flax modules: MLP, CNN, ResNet-18.
+
+TPU notes: every module takes ``compute_dtype`` (default bfloat16 on TPU
+via Settings.DEFAULT_DTYPE staying float32 for params) so the MXU sees
+bf16 matmuls/convs; logits are always returned float32 for a stable
+softmax. Shapes are static; no python control flow depends on data.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tpfl.learning.model import TpflModel
+
+
+class MLP(nn.Module):
+    """MLP matching the reference example (784-256-128-10,
+    lightning_model.py:118 / flax_model.py:171). Flattens any input."""
+
+    hidden_sizes: Sequence[int] = (256, 128)
+    out_channels: int = 10
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1)).astype(self.compute_dtype)
+        for h in self.hidden_sizes:
+            x = nn.Dense(h, dtype=self.compute_dtype)(x)
+            x = nn.relu(x)
+        x = nn.Dense(self.out_channels, dtype=self.compute_dtype)(x)
+        return x.astype(jnp.float32)
+
+
+class CNN(nn.Module):
+    """Small conv net for 32×32×3 (CIFAR-10 benchmark tier)."""
+
+    channels: Sequence[int] = (32, 64)
+    dense: int = 128
+    out_channels: int = 10
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.ndim == 3:  # grayscale [B, H, W] -> [B, H, W, 1]
+            x = x[..., None]
+        x = x.astype(self.compute_dtype)
+        for ch in self.channels:
+            x = nn.Conv(ch, (3, 3), dtype=self.compute_dtype)(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.dense, dtype=self.compute_dtype)(x))
+        x = nn.Dense(self.out_channels, dtype=self.compute_dtype)(x)
+        return x.astype(jnp.float32)
+
+
+class ResidualBlock(nn.Module):
+    channels: int
+    strides: tuple[int, int] = (1, 1)
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            dtype=self.compute_dtype,
+        )
+        residual = x
+        y = nn.Conv(
+            self.channels, (3, 3), self.strides, use_bias=False,
+            dtype=self.compute_dtype,
+        )(x)
+        y = nn.relu(norm()(y))
+        y = nn.Conv(
+            self.channels, (3, 3), use_bias=False, dtype=self.compute_dtype
+        )(y)
+        y = norm()(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(
+                self.channels, (1, 1), self.strides, use_bias=False,
+                dtype=self.compute_dtype,
+            )(residual)
+            residual = norm()(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet18(nn.Module):
+    """ResNet-18 (CIFAR variant: 3×3 stem, no max-pool) for the
+    CIFAR-100 benchmark tier. Uses BatchNorm, so callers must thread
+    ``batch_stats`` (TpflModel.aux_state carries it between rounds)."""
+
+    out_channels: int = 100
+    stage_sizes: Sequence[int] = (2, 2, 2, 2)
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.ndim == 3:
+            x = x[..., None]
+        x = x.astype(self.compute_dtype)
+        x = nn.Conv(64, (3, 3), use_bias=False, dtype=self.compute_dtype)(x)
+        x = nn.relu(
+            nn.BatchNorm(
+                use_running_average=not train, momentum=0.9,
+                dtype=self.compute_dtype,
+            )(x)
+        )
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for b in range(n_blocks):
+                strides = (2, 2) if i > 0 and b == 0 else (1, 1)
+                x = ResidualBlock(
+                    64 * 2**i, strides, compute_dtype=self.compute_dtype
+                )(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.out_channels, dtype=self.compute_dtype)(x)
+        return x.astype(jnp.float32)
+
+
+def create_model(
+    module: nn.Module | str,
+    input_shape: Sequence[int],
+    seed: int = 0,
+    **module_kwargs: Any,
+) -> TpflModel:
+    """Initialize a flax module into a :class:`TpflModel`.
+
+    ``module`` may be a module instance or a zoo name ("mlp", "cnn",
+    "resnet18"). ``input_shape`` excludes the batch dimension.
+    """
+    if isinstance(module, str):
+        zoo: dict[str, Callable[..., nn.Module]] = {
+            "mlp": MLP,
+            "cnn": CNN,
+            "resnet18": ResNet18,
+        }
+        if module not in zoo:
+            raise KeyError(f"Unknown model {module!r}; have {sorted(zoo)}")
+        module = zoo[module](**module_kwargs)
+    dummy = jnp.zeros((1, *input_shape), jnp.float32)
+    variables = module.init(jax.random.PRNGKey(seed), dummy, train=False)
+    params = variables["params"]
+    aux = {k: v for k, v in variables.items() if k != "params"} or None
+    return TpflModel(module=module, params=params, aux_state=aux)
