@@ -1,0 +1,227 @@
+"""Communication layer tests — mirrors the reference's
+``test/communication/communication_test.py`` contract: connection
+errors, handshake symmetry, gossip discovery of indirect peers,
+disconnect propagation, abrupt-death eviction, plus dedup/TTL and the
+synchronous model-gossip loop. Parametrized over protocol classes so the
+future gRPC transport slots into the same suite."""
+
+import threading
+import time
+
+import pytest
+
+from tpfl.communication import InMemoryCommunicationProtocol
+from tpfl.communication.memory import clear_registry
+from tpfl.communication.message import Message
+from tpfl.exceptions import CommunicationError
+from tpfl.settings import Settings
+
+PROTOCOLS = [InMemoryCommunicationProtocol]
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    clear_registry()
+    yield
+    clear_registry()
+
+
+def make_nodes(protocol_class, n):
+    nodes = [protocol_class() for _ in range(n)]
+    for nd in nodes:
+        nd.start()
+    return nodes
+
+
+def stop_all(nodes):
+    for nd in nodes:
+        nd.stop()
+
+
+@pytest.mark.parametrize("protocol_class", PROTOCOLS)
+def test_not_started_errors(protocol_class):
+    p = protocol_class()
+    with pytest.raises(CommunicationError):
+        p.connect("nowhere")
+    p.start()
+    with pytest.raises(CommunicationError):
+        p.start()  # double start
+    p.stop()
+
+
+@pytest.mark.parametrize("protocol_class", PROTOCOLS)
+def test_invalid_connect(protocol_class):
+    (a,) = make_nodes(protocol_class, 1)
+    assert not a.connect(a.get_address())  # self
+    assert not a.connect("ghost-address")  # unreachable
+    assert a.get_neighbors() == {}
+    stop_all([a])
+
+
+@pytest.mark.parametrize("protocol_class", PROTOCOLS)
+def test_handshake_symmetry(protocol_class):
+    a, b = make_nodes(protocol_class, 2)
+    assert a.connect(b.get_address())
+    assert b.get_address() in a.get_neighbors(only_direct=True)
+    assert a.get_address() in b.get_neighbors(only_direct=True)
+    # double connect refused
+    assert not a.connect(b.get_address())
+    stop_all([a, b])
+
+
+@pytest.mark.parametrize("protocol_class", PROTOCOLS)
+def test_disconnect_propagation(protocol_class):
+    a, b = make_nodes(protocol_class, 2)
+    a.connect(b.get_address())
+    a.disconnect(b.get_address())
+    assert b.get_address() not in a.get_neighbors()
+    assert a.get_address() not in b.get_neighbors()
+    stop_all([a, b])
+
+
+@pytest.mark.parametrize("protocol_class", PROTOCOLS)
+def test_message_dispatch_and_dedup(protocol_class):
+    a, b = make_nodes(protocol_class, 2)
+    a.connect(b.get_address())
+    got = []
+    b.add_command("probe", lambda source, round, args: got.append((source, args)))
+    msg = a.build_msg("probe", ["x", "y"], round=3)
+    a.send(b.get_address(), msg)
+    a.send(b.get_address(), msg)  # same hash -> dropped by dedup
+    assert got == [(a.get_address(), ["x", "y"])]
+    stop_all([a, b])
+
+
+@pytest.mark.parametrize("protocol_class", PROTOCOLS)
+def test_weights_dispatch(protocol_class):
+    a, b = make_nodes(protocol_class, 2)
+    a.connect(b.get_address())
+    got = {}
+    b.add_command(
+        "model",
+        lambda source, round, weights, contributors, num_samples: got.update(
+            dict(w=weights, c=contributors, n=num_samples, r=round)
+        ),
+    )
+    msg = a.build_weights("model", 2, b"\x01\x02", ["a"], 7)
+    a.send(b.get_address(), msg)
+    assert got == {"w": b"\x01\x02", "c": ["a"], "n": 7, "r": 2}
+    stop_all([a, b])
+
+
+@pytest.mark.parametrize("protocol_class", PROTOCOLS)
+def test_gossip_discovers_indirect_peers(protocol_class):
+    # Line topology a-b-c: a learns about c through b's gossiped beats.
+    a, b, c = make_nodes(protocol_class, 3)
+    a.connect(b.get_address())
+    b.connect(c.get_address())
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if c.get_address() in a.get_neighbors() and a.get_address() in c.get_neighbors():
+            break
+        time.sleep(0.05)
+    assert c.get_address() in a.get_neighbors()
+    # ...but NOT as a direct neighbor.
+    assert c.get_address() not in a.get_neighbors(only_direct=True)
+    stop_all([a, b, c])
+
+
+@pytest.mark.parametrize("protocol_class", PROTOCOLS)
+def test_abrupt_death_eviction(protocol_class):
+    a, b = make_nodes(protocol_class, 2)
+    a.connect(b.get_address())
+    b.stop()  # no disconnect message — simulates a crash
+    deadline = time.time() + Settings.HEARTBEAT_TIMEOUT + 3
+    while time.time() < deadline:
+        if b.get_address() not in a.get_neighbors():
+            break
+        time.sleep(0.1)
+    assert b.get_address() not in a.get_neighbors()
+    stop_all([a])
+
+
+@pytest.mark.parametrize("protocol_class", PROTOCOLS)
+def test_broadcast_reaches_all_direct_neighbors(protocol_class):
+    hub, s1, s2 = make_nodes(protocol_class, 3)
+    hub.connect(s1.get_address())
+    hub.connect(s2.get_address())
+    got = []
+    for nd in (s1, s2):
+        nd.add_command(
+            "ping", lambda source, round, args, _n=nd: got.append(_n.get_address())
+        )
+    hub.broadcast(hub.build_msg("ping"))
+    assert sorted(got) == sorted([s1.get_address(), s2.get_address()])
+    stop_all([hub, s1, s2])
+
+
+@pytest.mark.parametrize("protocol_class", PROTOCOLS)
+def test_ttl_flood_reaches_line_ends(protocol_class):
+    # a-b-c-d line: a control message from a floods to d via TTL gossip.
+    nodes = make_nodes(protocol_class, 4)
+    for x, y in zip(nodes, nodes[1:]):
+        x.connect(y.get_address())
+    got = threading.Event()
+    for nd in nodes[1:3]:
+        nd.add_command("flood", lambda source, round, args: None)
+    nodes[3].add_command("flood", lambda source, round, args: got.set())
+    nodes[0].broadcast(nodes[0].build_msg("flood"))
+    assert got.wait(timeout=5)
+    stop_all(nodes)
+
+
+@pytest.mark.parametrize("protocol_class", PROTOCOLS)
+def test_gossip_weights_until_early_stop(protocol_class):
+    a, b = make_nodes(protocol_class, 2)
+    a.connect(b.get_address())
+    received = []
+    b.add_command(
+        "part",
+        lambda source, round, weights, contributors, num_samples: received.append(
+            weights
+        ),
+    )
+    stop_after = {"n": 0}
+
+    def early_stop():
+        stop_after["n"] += 1
+        return len(received) >= 2
+
+    a.gossip_weights(
+        early_stopping_fn=early_stop,
+        get_candidates_fn=lambda: [b.get_address()],
+        status_fn=lambda: len(received),
+        model_fn=lambda nei: a.build_weights("part", 0, b"w", ["a"], 1),
+        period=0.01,
+    )
+    assert len(received) >= 2
+    stop_all([a, b])
+
+
+@pytest.mark.parametrize("protocol_class", PROTOCOLS)
+def test_gossip_weights_exits_on_static_status(protocol_class):
+    a, b = make_nodes(protocol_class, 2)
+    a.connect(b.get_address())
+    b.add_command("part", lambda **kwargs: None)
+    t0 = time.time()
+    a.gossip_weights(
+        early_stopping_fn=lambda: False,
+        get_candidates_fn=lambda: [b.get_address()],
+        status_fn=lambda: "static",
+        model_fn=lambda nei: a.build_weights("part", 0, b"w", ["a"], 1),
+        period=0.01,
+    )
+    # Exited via GOSSIP_EXIT_ON_X_EQUAL_ROUNDS, not hung.
+    assert time.time() - t0 < 5
+    stop_all([a, b])
+
+
+def test_message_wire_roundtrip():
+    m = Message(
+        source="a", cmd="model", round=2, args=["1"], ttl=3,
+        payload=b"\x00\x01", contributors=["a", "b"], num_samples=5,
+    ).new_hash()
+    m2 = Message.from_bytes(m.to_bytes())
+    assert m2.source == "a" and m2.cmd == "model" and m2.round == 2
+    assert m2.payload == b"\x00\x01" and m2.contributors == ["a", "b"]
+    assert m2.msg_hash == m.msg_hash and m2.ttl == 3 and m2.num_samples == 5

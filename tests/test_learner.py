@@ -19,7 +19,7 @@ def mnist():
     return synthetic_mnist(n_train=256, n_test=128, seed=1)
 
 
-def make_learner(mnist, aggregator=None, addr="node-a", lr=1e-2):
+def make_learner(mnist, aggregator=None, addr="node-a", lr=0.1):
     model = create_model("mlp", (28, 28), seed=0, hidden_sizes=(32,))
     return JaxLearner(
         model=model,

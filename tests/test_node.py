@@ -1,0 +1,137 @@
+"""End-to-end federated learning tests — the reference's
+``test/node_test.py`` contract (test_convergence): real multi-node runs
+in one process, asserting the exact stage-history pattern per round,
+cross-node model agreement, and final accuracy > 0.5."""
+
+import numpy as np
+import pytest
+
+from tpfl.communication.memory import clear_registry
+from tpfl.learning.dataset import RandomIIDPartitionStrategy, synthetic_mnist
+from tpfl.models import create_model
+from tpfl.node import Node
+from tpfl.settings import Settings
+from tpfl.utils import (
+    TopologyFactory,
+    TopologyType,
+    check_equal_models,
+    wait_convergence,
+    wait_to_finish,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    clear_registry()
+    yield
+    clear_registry()
+
+
+def build_nodes(n, rounds_data_seed=0, lr=0.1):
+    ds = synthetic_mnist(
+        n_train=200 * n, n_test=40 * n, seed=rounds_data_seed, noise=0.4
+    )
+    parts = ds.generate_partitions(n, RandomIIDPartitionStrategy, seed=1)
+    nodes = []
+    for i in range(n):
+        model = create_model("mlp", (28, 28), seed=7, hidden_sizes=(32,))
+        nodes.append(
+            Node(
+                model,
+                parts[i],
+                learning_rate=lr,
+                batch_size=32,
+            )
+        )
+    for nd in nodes:
+        nd.start()
+    return nodes
+
+
+def assert_stage_history(node, rounds, trained_some_round):
+    h = node.learning_workflow.history
+    assert h[0] == "StartLearningStage"
+    rest = h[1:]
+    # Per round: Vote -> (Train|Wait) -> Gossip -> RoundFinished
+    assert len(rest) == 4 * rounds, f"history: {h}"
+    for r in range(rounds):
+        chunk = rest[4 * r : 4 * r + 4]
+        assert chunk[0] == "VoteTrainSetStage"
+        assert chunk[1] in ("TrainStage", "WaitAggregatedModelsStage")
+        assert chunk[2] == "GossipModelStage"
+        assert chunk[3] == "RoundFinishedStage"
+
+
+@pytest.mark.parametrize("n,rounds", [(2, 2), (4, 2)])
+def test_convergence(n, rounds):
+    nodes = build_nodes(n)
+    try:
+        matrix = TopologyFactory.generate_matrix(TopologyType.LINE, n)
+        TopologyFactory.connect_nodes(matrix, nodes)
+        wait_convergence(nodes, n - 1, only_direct=False, wait=10)
+
+        nodes[0].set_start_learning(rounds=rounds, epochs=2)
+        wait_to_finish(nodes, timeout=180)
+
+        for nd in nodes:
+            assert_stage_history(nd, rounds, None)
+        check_equal_models(nodes)
+        # All nodes elected every round (n <= TRAIN_SET_SIZE): everyone
+        # trained, so everyone holds the aggregated model.
+        accs = [nd.learner.evaluate()["test_metric"] for nd in nodes]
+        assert all(a > 0.5 for a in accs), accs
+    finally:
+        for nd in nodes:
+            nd.stop()
+
+
+def test_star_topology_converges():
+    n = 3
+    nodes = build_nodes(n)
+    try:
+        matrix = TopologyFactory.generate_matrix(TopologyType.STAR, n)
+        TopologyFactory.connect_nodes(matrix, nodes)
+        wait_convergence(nodes, n - 1, only_direct=False, wait=10)
+        nodes[1].set_start_learning(rounds=1, epochs=1)
+        wait_to_finish(nodes, timeout=120)
+        check_equal_models(nodes)
+    finally:
+        for nd in nodes:
+            nd.stop()
+
+
+def test_interrupt_learning():
+    nodes = build_nodes(2)
+    try:
+        nodes[0].connect(nodes[1].addr)
+        wait_convergence(nodes, 1, wait=5)
+        nodes[0].set_start_learning(rounds=50, epochs=1)
+        import time
+
+        time.sleep(1.0)
+        for nd in nodes:
+            nd.stop_learning()
+        wait_to_finish(nodes, timeout=30)
+        assert all(nd.state.status == "Idle" for nd in nodes)
+    finally:
+        for nd in nodes:
+            nd.stop()
+
+
+def test_node_lifecycle_errors():
+    from tpfl.exceptions import NodeRunningException, ZeroRoundsException
+
+    ds = synthetic_mnist(n_train=64, n_test=16, seed=0)
+    model = create_model("mlp", (28, 28), seed=0, hidden_sizes=(16,))
+    node = Node(model, ds)
+    with pytest.raises(NodeRunningException):
+        node.connect("x")
+    with pytest.raises(NodeRunningException):
+        node.set_start_learning(1, 1)
+    node.start()
+    with pytest.raises(NodeRunningException):
+        node.start()
+    with pytest.raises(ZeroRoundsException):
+        node.set_start_learning(0, 1)
+    node.stop()
+    node.stop()  # idempotent

@@ -1,0 +1,24 @@
+"""Communication layer: the decentralized control/data plane.
+
+Capability parity with the reference's ``p2pfl/communication/`` —
+application-level gossip (TTL-flooded control messages, synchronous
+convergence-driven model gossip, heartbeat liveness) behind a pluggable
+transport ABC with in-memory and gRPC implementations.
+
+TPU-native differences: the wire format is the msgpack envelope from
+:mod:`tpfl.learning.serialization` (never pickle); peer sampling in the
+gossiper is seeded for reproducible simulations; and when all train-set
+nodes live in one process/mesh the data plane can short-circuit to exact
+on-device collectives (``tpfl.parallel``) while this layer keeps only
+the control plane.
+"""
+
+from tpfl.communication.message import Message
+from tpfl.communication.protocol import CommunicationProtocol
+from tpfl.communication.memory import InMemoryCommunicationProtocol
+
+__all__ = [
+    "Message",
+    "CommunicationProtocol",
+    "InMemoryCommunicationProtocol",
+]

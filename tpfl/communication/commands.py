@@ -1,0 +1,254 @@
+"""Application protocol verbs (commands).
+
+Parity with reference ``p2pfl/communication/commands/`` — the 11 verbs
+dispatched by the transport's server into node internals
+(``command.py:24-43`` ABC; registration ``node.py:122-134``).
+
+Heartbeat is transport-internal here (the protocol registers its own
+``beat`` handler), so this module defines the remaining verbs. Each
+command binds to the node facade at construction and mutates
+``NodeState`` / ``Aggregator`` / ``Learner`` exactly at the reference's
+synchronization points.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Any, TYPE_CHECKING
+
+from tpfl.management.logger import logger
+
+if TYPE_CHECKING:
+    from tpfl.node import Node
+
+
+class Command(ABC):
+    """Verb ABC (reference command.py:24-43)."""
+
+    name: str = "unnamed"
+
+    @classmethod
+    def get_name(cls) -> str:
+        return cls.name
+
+    @abstractmethod
+    def execute(self, source: str, round: int, **kwargs: Any) -> None: ...
+
+
+class NodeCommand(Command):
+    def __init__(self, node: "Node") -> None:
+        self.node = node
+
+    @property
+    def state(self):
+        return self.node.state
+
+
+class StartLearningCommand(NodeCommand):
+    """Peer asks us to join an experiment (reference
+    start_learning_command.py:26-58): spawn the learning thread with the
+    broadcast (rounds, epochs)."""
+
+    name = "start_learning"
+
+    def execute(self, source: str, round: int, args: list[str], **kwargs: Any) -> None:
+        rounds, epochs = int(args[0]), int(args[1])
+        self.node.start_learning_thread(rounds, epochs)
+
+
+class StopLearningCommand(NodeCommand):
+    """Abort the experiment (reference stop_learning_command.py:30)."""
+
+    name = "stop_learning"
+
+    def execute(self, source: str, round: int, **kwargs: Any) -> None:
+        self.node.stop_learning()
+
+
+class ModelInitializedCommand(NodeCommand):
+    """Peer announces its model is initialized (reference
+    model_initialized_command.py:25): nei_status[source] = -1."""
+
+    name = "model_initialized"
+
+    def execute(self, source: str, round: int, **kwargs: Any) -> None:
+        self.state.nei_status[source] = -1
+
+
+class VoteTrainSetCommand(NodeCommand):
+    """Train-set vote intake (reference vote_train_set_command.py:28):
+    args are flattened (candidate, weight) pairs; accept current or next
+    round (validation may arrive before our round increments)."""
+
+    name = "vote_train_set"
+
+    def execute(self, source: str, round: int, args: list[str], **kwargs: Any) -> None:
+        st = self.state
+        if st.round is None or round not in (st.round, st.round + 1):
+            logger.debug(
+                st.addr,
+                f"Vote from {source} for round {round} dropped (at {st.round})",
+            )
+            return
+        votes = dict(zip(args[::2], (int(w) for w in args[1::2])))
+        with st.train_set_votes_lock:
+            st.train_set_votes[source] = (round, votes)
+        st.votes_ready_event.set()
+
+
+class ModelsAggregatedCommand(NodeCommand):
+    """Peer reports which contributors its aggregation covers
+    (reference models_agregated_command.py:26)."""
+
+    name = "models_aggregated"
+
+    def execute(self, source: str, round: int, args: list[str], **kwargs: Any) -> None:
+        if round != self.state.round:
+            return
+        self.state.set_models_aggregated(source, list(args))
+
+
+class ModelsReadyCommand(NodeCommand):
+    """Peer finished its round (reference models_ready_command.py:26):
+    accept round-1 or round; nei_status[source] = round."""
+
+    name = "models_ready"
+
+    def execute(self, source: str, round: int, **kwargs: Any) -> None:
+        st = self.state
+        if st.round is None or round not in (st.round - 1, st.round):
+            logger.debug(
+                st.addr,
+                f"ModelsReady from {source} round {round} dropped (at {st.round})",
+            )
+            return
+        st.nei_status[source] = round
+
+
+class MetricsCommand(NodeCommand):
+    """Gossiped eval metrics (reference metrics_command.py:26): args are
+    flattened (name, value) pairs."""
+
+    name = "metrics"
+
+    def execute(self, source: str, round: int, args: list[str], **kwargs: Any) -> None:
+        for name, value in zip(args[::2], args[1::2]):
+            logger.log_metric(source, name, float(value), round=round)
+
+
+class InitModelCommand(NodeCommand):
+    """Initial weights arrive (reference init_model_command.py:31,46-97):
+    only accepted while uninitialized; sets the init event."""
+
+    name = "init_model"
+
+    def execute(
+        self,
+        source: str,
+        round: int,
+        weights: bytes,
+        contributors: list[str],
+        num_samples: int,
+        **kwargs: Any,
+    ) -> None:
+        st = self.state
+        if st.model_initialized_event.is_set():
+            logger.debug(st.addr, f"InitModel from {source} ignored (already init)")
+            return
+        try:
+            self.node.learner.set_model(weights)
+        except Exception as e:
+            logger.error(st.addr, f"InitModel decode failed: {e}")
+            return
+        st.model_initialized_event.set()
+        logger.info(st.addr, f"Model initialized from {source}")
+        # Announce so peers stop gossiping init weights at us.
+        self.node.communication.broadcast(
+            self.node.communication.build_msg(ModelInitializedCommand.name)
+        )
+
+
+class PartialModelCommand(NodeCommand):
+    """Partial aggregate from a train-set peer (reference
+    partial_model_command.py:33,56-113): add to aggregator, then
+    re-announce our coverage."""
+
+    name = "partial_model"
+
+    def execute(
+        self,
+        source: str,
+        round: int,
+        weights: bytes,
+        contributors: list[str],
+        num_samples: int,
+        **kwargs: Any,
+    ) -> None:
+        st = self.state
+        if st.round is None:
+            return
+        if round != st.round:
+            logger.debug(
+                st.addr,
+                f"PartialModel from {source} round {round} dropped (at {st.round})",
+            )
+            return
+        if not st.train_set:
+            logger.debug(st.addr, f"PartialModel from {source} dropped (no train set)")
+            return
+        try:
+            model = self.node.learner.get_model().build_copy(params=weights)
+        except Exception as e:
+            logger.error(st.addr, f"PartialModel decode failed: {e}")
+            return
+        covered = self.node.aggregator.add_model(model)
+        if covered:
+            self.node.communication.broadcast(
+                self.node.communication.build_msg(
+                    ModelsAggregatedCommand.name, covered, round=st.round
+                )
+            )
+
+
+class FullModelCommand(NodeCommand):
+    """Aggregated round result arrives (reference
+    full_model_command.py:31,46-89): set it and release the wait
+    stage."""
+
+    name = "full_model"
+
+    def execute(
+        self,
+        source: str,
+        round: int,
+        weights: bytes,
+        contributors: list[str],
+        num_samples: int,
+        **kwargs: Any,
+    ) -> None:
+        st = self.state
+        if st.round is None:
+            return
+        if round < st.round:
+            return
+        try:
+            self.node.learner.set_model(weights)
+        except Exception as e:
+            logger.error(st.addr, f"FullModel decode failed: {e}")
+            return
+        st.last_full_model_round = max(st.last_full_model_round, round)
+        st.aggregated_model_event.set()
+
+
+ALL_COMMANDS = [
+    StartLearningCommand,
+    StopLearningCommand,
+    ModelInitializedCommand,
+    VoteTrainSetCommand,
+    ModelsAggregatedCommand,
+    ModelsReadyCommand,
+    MetricsCommand,
+    InitModelCommand,
+    PartialModelCommand,
+    FullModelCommand,
+]

@@ -1,0 +1,148 @@
+"""Gossiper — async control-message flooding + synchronous model gossip.
+
+Parity with reference ``communication/protocols/gossiper.py:31-239``:
+
+- dedup ring buffer ``check_and_set_processed``          (:103-122)
+- async fan-out thread respecting GOSSIP_MESSAGES_PER_PERIOD (:124-157)
+- synchronous ``gossip_weights`` loop: early-stop → candidates →
+  static-status termination → random peer sample → model_fn → send
+  (:163-239)
+
+TPU-native difference: peer sampling is seeded from (Settings.SEED,
+node addr) so simulated federations are reproducible — the reference
+uses bare ``random.sample`` (gossiper.py:226), which defeats the fork's
+own determinism goal.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+import zlib
+from collections import deque
+from typing import Any, Callable, Optional
+
+from tpfl.communication.message import Message
+from tpfl.management.logger import logger
+from tpfl.settings import Settings
+
+
+class Gossiper(threading.Thread):
+    """Owns the pending-message queue and the dedup ring buffer."""
+
+    def __init__(
+        self,
+        self_addr: str,
+        send_fn: Callable[[str, Message], None],
+        get_neighbors_fn: Callable[[bool], dict[str, Any]],
+    ) -> None:
+        super().__init__(daemon=True, name=f"gossiper-{self_addr}")
+        self._addr = self_addr
+        self._send = send_fn
+        self._get_neighbors = get_neighbors_fn
+        self._pending: deque[Message] = deque()
+        self._pending_lock = threading.Lock()
+        self._processed: deque[str] = deque(
+            maxlen=Settings.AMOUNT_LAST_MESSAGES_SAVED
+        )
+        self._processed_lock = threading.Lock()
+        self._stop_event = threading.Event()
+        seed = (Settings.SEED or 0) + zlib.crc32(self_addr.encode())
+        self._rng = random.Random(seed)
+
+    # --- dedup (reference gossiper.py:103-122) ---
+
+    def check_and_set_processed(self, msg_hash: str) -> bool:
+        """True if unseen (and marks it seen)."""
+        if not msg_hash:
+            return True
+        with self._processed_lock:
+            if msg_hash in self._processed:
+                return False
+            self._processed.append(msg_hash)
+            return True
+
+    # --- async message flood (reference gossiper.py:124-157) ---
+
+    def add_message(self, msg: Message) -> None:
+        with self._pending_lock:
+            self._pending.append(msg)
+
+    def run(self) -> None:
+        while not self._stop_event.is_set():
+            batch: list[Message] = []
+            with self._pending_lock:
+                for _ in range(
+                    min(len(self._pending), Settings.GOSSIP_MESSAGES_PER_PERIOD)
+                ):
+                    batch.append(self._pending.popleft())
+            for msg in batch:
+                for nei in self._get_neighbors(True):
+                    if nei != msg.source:
+                        try:
+                            self._send(nei, msg)
+                        except Exception as e:
+                            logger.debug(
+                                self._addr, f"Gossip to {nei} failed: {e}"
+                            )
+            # Settings read at use-time so tests can zero the period.
+            period = Settings.GOSSIP_PERIOD
+            if period > 0:
+                self._stop_event.wait(period)
+            elif not batch:
+                self._stop_event.wait(0.001)
+
+    def stop(self) -> None:
+        self._stop_event.set()
+
+    # --- synchronous model gossip (reference gossiper.py:163-239) ---
+
+    def gossip_weights(
+        self,
+        early_stopping_fn: Callable[[], bool],
+        get_candidates_fn: Callable[[], list[str]],
+        status_fn: Callable[[], Any],
+        model_fn: Callable[[str], Optional[Message]],
+        period: Optional[float] = None,
+        send_fn: Optional[Callable[[str, Message], None]] = None,
+    ) -> None:
+        """Push models to sampled peers until convergence or early stop.
+
+        Termination conditions (reference order): ``early_stopping_fn``
+        true; no candidates; status unchanged for
+        GOSSIP_EXIT_ON_X_EQUAL_ROUNDS iterations.
+        """
+        if period is None:
+            period = Settings.GOSSIP_MODELS_PERIOD
+        send = send_fn or self._send
+        last_statuses: deque[Any] = deque(
+            maxlen=Settings.GOSSIP_EXIT_ON_X_EQUAL_ROUNDS
+        )
+        while True:
+            if early_stopping_fn():
+                return
+            candidates = get_candidates_fn()
+            if not candidates:
+                return
+            status = status_fn()
+            last_statuses.append(status)
+            if (
+                len(last_statuses) == last_statuses.maxlen
+                and all(s == last_statuses[0] for s in last_statuses)
+            ):
+                logger.info(
+                    self._addr,
+                    f"Gossip exit: status static for {last_statuses.maxlen} rounds",
+                )
+                return
+            n = min(Settings.GOSSIP_MODELS_PER_ROUND, len(candidates))
+            for nei in self._rng.sample(candidates, n):
+                msg = model_fn(nei)
+                if msg is None:
+                    continue
+                try:
+                    send(nei, msg)
+                except Exception as e:
+                    logger.debug(self._addr, f"Model gossip to {nei} failed: {e}")
+            time.sleep(period)

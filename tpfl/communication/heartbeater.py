@@ -1,0 +1,56 @@
+"""Heartbeater — liveness broadcasting + stale-peer eviction.
+
+Parity with reference ``communication/protocols/heartbeater.py:33-113``:
+broadcast a ``beat`` every HEARTBEAT_PERIOD, evict neighbors silent for
+HEARTBEAT_TIMEOUT. Beats gossip with TTL, so non-direct peers are
+discovered passively (reference heartbeater.py:64-78).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+from tpfl.communication.message import Message
+from tpfl.communication.neighbors import Neighbors
+from tpfl.management.logger import logger
+from tpfl.settings import Settings
+
+HEARTBEAT_CMD = "beat"
+
+
+class Heartbeater(threading.Thread):
+    def __init__(
+        self,
+        self_addr: str,
+        neighbors: Neighbors,
+        broadcast_fn: Callable[[Message], None],
+        build_msg_fn: Callable[..., Message],
+    ) -> None:
+        super().__init__(daemon=True, name=f"heartbeater-{self_addr}")
+        self._addr = self_addr
+        self._neighbors = neighbors
+        self._broadcast = broadcast_fn
+        self._build_msg = build_msg_fn
+        self._stop_event = threading.Event()
+
+    def beat(self, source: str, beat_time: float) -> None:
+        """Incoming beat: refresh or learn the peer."""
+        self._neighbors.refresh_or_add(source, beat_time=time.time())
+
+    def run(self) -> None:
+        while not self._stop_event.is_set():
+            try:
+                self._broadcast(
+                    self._build_msg(HEARTBEAT_CMD, [str(time.time())])
+                )
+            except Exception as e:
+                logger.debug(self._addr, f"Heartbeat broadcast failed: {e}")
+            evicted = self._neighbors.evict_stale(Settings.HEARTBEAT_TIMEOUT)
+            for a in evicted:
+                logger.info(self._addr, f"Heartbeat timeout, evicted {a}")
+            self._stop_event.wait(Settings.HEARTBEAT_PERIOD)
+
+    def stop(self) -> None:
+        self._stop_event.set()

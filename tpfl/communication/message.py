@@ -1,0 +1,84 @@
+"""Transport-neutral message model.
+
+Replaces the reference's protobuf ``RootMessage{source, round, cmd,
+oneof {Message | Weights}}`` (``grpc/proto/node.proto:26-46``) with one
+dataclass that the in-memory transport passes by reference and the gRPC
+transport serializes as a msgpack envelope (pickle-free, dtype-safe).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+import msgpack
+
+_counter = itertools.count()
+_counter_lock = threading.Lock()
+
+
+def _next_uid() -> int:
+    with _counter_lock:
+        return next(_counter)
+
+
+@dataclass
+class Message:
+    """One protocol datagram: either a control message (args + ttl) or a
+    weights transfer (payload + contributors + num_samples)."""
+
+    source: str
+    cmd: str
+    round: int = -1
+    args: list[str] = field(default_factory=list)
+    ttl: int = 0
+    msg_hash: str = ""
+    payload: Optional[bytes] = None
+    contributors: list[str] = field(default_factory=list)
+    num_samples: int = 0
+
+    @property
+    def is_weights(self) -> bool:
+        return self.payload is not None
+
+    def new_hash(self) -> "Message":
+        """Unique id for gossip dedup (reference grpc_client.py:54-83
+        hashes cmd+args+time+rand; a process-unique counter is collision
+        free and deterministic)."""
+        self.msg_hash = f"{self.source}#{_next_uid()}"
+        return self
+
+    # --- wire format (used by the gRPC transport) ---
+
+    def to_bytes(self) -> bytes:
+        return msgpack.packb(
+            {
+                "src": self.source,
+                "cmd": self.cmd,
+                "rnd": self.round,
+                "args": [str(a) for a in self.args],
+                "ttl": self.ttl,
+                "h": self.msg_hash,
+                "w": self.payload,
+                "c": self.contributors,
+                "n": self.num_samples,
+            },
+            use_bin_type=True,
+        )
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "Message":
+        d = msgpack.unpackb(raw, raw=False)
+        return cls(
+            source=d["src"],
+            cmd=d["cmd"],
+            round=d["rnd"],
+            args=list(d["args"]),
+            ttl=d["ttl"],
+            msg_hash=d["h"],
+            payload=d["w"],
+            contributors=list(d["c"]),
+            num_samples=d["n"],
+        )

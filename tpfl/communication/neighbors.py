@@ -1,0 +1,123 @@
+"""Peer table.
+
+Parity with reference ``communication/protocols/neighbors.py:73-167``:
+thread-safe ``addr -> (connection, direct?, last_beat)`` map, where
+direct neighbors are handshaken transports and non-direct ones are
+liveness-only entries learned from gossiped heartbeats.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+
+@dataclass
+class Neighbor:
+    conn: Any  # transport-specific handle (None for non-direct peers)
+    direct: bool
+    last_beat: float
+
+
+class Neighbors:
+    """Thread-safe peer table shared by client/gossiper/heartbeater."""
+
+    def __init__(
+        self,
+        self_addr: str,
+        connect_fn: Optional[Callable[[str], Any]] = None,
+        disconnect_fn: Optional[Callable[[str, Any], None]] = None,
+    ) -> None:
+        self.self_addr = self_addr
+        self._connect_fn = connect_fn
+        self._disconnect_fn = disconnect_fn
+        self._neighbors: dict[str, Neighbor] = {}
+        self._lock = threading.Lock()
+
+    def add(self, addr: str, non_direct: bool = False, conn: Any = None) -> bool:
+        """Add a peer; direct adds may build a transport connection via
+        the protocol's connect_fn. Returns success."""
+        if addr == self.self_addr:
+            return False
+        with self._lock:
+            existing = self._neighbors.get(addr)
+            if existing is not None:
+                # Upgrade non-direct -> direct if needed.
+                if existing.direct or non_direct:
+                    existing.last_beat = time.time()
+                    return True
+        if not non_direct and self._connect_fn is not None and conn is None:
+            try:
+                conn = self._connect_fn(addr)
+            except Exception:
+                return False
+            if conn is None:
+                return False
+        with self._lock:
+            self._neighbors[addr] = Neighbor(
+                conn=conn, direct=not non_direct, last_beat=time.time()
+            )
+        return True
+
+    def remove(self, addr: str, disconnect_msg: bool = False) -> None:
+        with self._lock:
+            nei = self._neighbors.pop(addr, None)
+        if (
+            disconnect_msg
+            and nei is not None
+            and nei.direct
+            and self._disconnect_fn is not None
+        ):
+            try:
+                self._disconnect_fn(addr, nei.conn)
+            except Exception:
+                pass
+
+    def refresh_or_add(self, addr: str, beat_time: Optional[float] = None) -> None:
+        """Heartbeat intake (reference heartbeater.py:64-78): refresh a
+        known peer or learn a non-direct one."""
+        if addr == self.self_addr:
+            return
+        t = beat_time if beat_time is not None else time.time()
+        with self._lock:
+            nei = self._neighbors.get(addr)
+            if nei is not None:
+                nei.last_beat = t
+                return
+        self.add(addr, non_direct=True)
+
+    def get(self, addr: str) -> Optional[Neighbor]:
+        with self._lock:
+            return self._neighbors.get(addr)
+
+    def exists(self, addr: str) -> bool:
+        with self._lock:
+            return addr in self._neighbors
+
+    def get_all(self, only_direct: bool = False) -> dict[str, Neighbor]:
+        with self._lock:
+            return {
+                a: n
+                for a, n in self._neighbors.items()
+                if n.direct or not only_direct
+            }
+
+    def evict_stale(self, timeout: float) -> list[str]:
+        """Drop peers not heard from within ``timeout`` (reference
+        heartbeater.py:93-103). Returns evicted addresses."""
+        now = time.time()
+        with self._lock:
+            stale = [
+                a for a, n in self._neighbors.items() if now - n.last_beat > timeout
+            ]
+        for a in stale:
+            self.remove(a)
+        return stale
+
+    def clear(self) -> None:
+        with self._lock:
+            addrs = list(self._neighbors)
+        for a in addrs:
+            self.remove(a, disconnect_msg=True)

@@ -1,0 +1,22 @@
+"""Experiment — round counter container (reference
+``p2pfl/experiment.py:21-53``)."""
+
+from __future__ import annotations
+
+
+class Experiment:
+    def __init__(self, exp_name: str, total_rounds: int) -> None:
+        self.exp_name = exp_name
+        self.total_rounds = int(total_rounds)
+        self.round: int = 0
+
+    def increase_round(self) -> None:
+        if self.round is None:
+            raise ValueError("Experiment round not initialized")
+        self.round += 1
+
+    def __repr__(self) -> str:
+        return (
+            f"Experiment(name={self.exp_name}, round={self.round}/"
+            f"{self.total_rounds})"
+        )

@@ -37,7 +37,10 @@ class TpflCallback(ABC):
         return self.name
 
     def get_info(self) -> dict[str, Any]:
-        return self._info
+        # Shallow copy: the returned dict is stored into models that may
+        # sit in aggregator queues or serialize on gossip threads while
+        # the next round's on_fit_end rebinds these keys.
+        return dict(self._info)
 
     def set_info(self, info: dict[str, Any]) -> None:
         self._info = dict(info)

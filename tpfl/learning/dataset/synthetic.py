@@ -46,18 +46,20 @@ def synthetic_classification(
 
 
 def synthetic_mnist(
-    n_train: int = 1000, n_test: int = 200, seed: int = 0
+    n_train: int = 1000, n_test: int = 200, seed: int = 0, noise: float = 0.8
 ) -> TpflDataset:
     """28×28 grayscale, 10 classes — MNIST-shaped."""
     return synthetic_classification(
-        (28, 28), n_classes=10, n_train=n_train, n_test=n_test, seed=seed
+        (28, 28), n_classes=10, n_train=n_train, n_test=n_test, seed=seed,
+        noise=noise,
     )
 
 
 def synthetic_cifar10(
-    n_train: int = 1000, n_test: int = 200, seed: int = 0
+    n_train: int = 1000, n_test: int = 200, seed: int = 0, noise: float = 0.8
 ) -> TpflDataset:
     """32×32×3, 10 classes — CIFAR-10-shaped."""
     return synthetic_classification(
-        (32, 32, 3), n_classes=10, n_train=n_train, n_test=n_test, seed=seed
+        (32, 32, 3), n_classes=10, n_train=n_train, n_test=n_test, seed=seed,
+        noise=noise,
     )

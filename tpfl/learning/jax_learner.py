@@ -53,7 +53,9 @@ def _addr_seed(addr: str) -> int:
 
 
 def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
-    return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+    """Per-sample loss vector [batch]; training takes the mean, masked
+    eval weights each sample — one definition serves both."""
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels)
 
 
 class JaxLearner(Learner):
@@ -65,9 +67,12 @@ class JaxLearner(Learner):
         addr: node address (metrics + seeding).
         aggregator: used only to build required callbacks.
         learning_rate / optimizer_factory: optax config; the factory
-            receives the learning rate (default: adam).
+            receives the learning rate. Default is SGD+momentum:
+            adaptive optimizers (adam) give locally-faster training
+            whose parameter averages collapse under FedAvg — local SGD
+            is the canonical choice (McMahan et al. 2016).
         batch_size: training batch size (eval uses the same).
-        loss_fn: (logits, labels) -> scalar.
+        loss_fn: (logits, labels) -> per-sample loss vector [batch].
     """
 
     def __init__(
@@ -76,21 +81,31 @@ class JaxLearner(Learner):
         data: Optional[TpflDataset] = None,
         addr: str = "unknown-node",
         aggregator: Optional[Any] = None,
-        learning_rate: float = 1e-3,
+        learning_rate: float = 0.1,
         optimizer_factory: Optional[Callable[[float], optax.GradientTransformation]] = None,
         batch_size: int = 64,
         loss_fn: Callable = cross_entropy_loss,
     ) -> None:
         super().__init__(model, data, addr, aggregator)
         self.learning_rate = float(learning_rate)
-        self._optimizer_factory = optimizer_factory or (lambda lr: optax.adam(lr))
+        self._optimizer_factory = optimizer_factory or (
+            lambda lr: optax.sgd(lr, momentum=0.9)
+        )
         self.batch_size = int(batch_size)
         self._loss_fn = loss_fn
         self._interrupt = threading.Event()
         self._round_counter = 0  # advances every fit() for shuffle seeding
-        # One cache per learner: jitted fns close over the module.
+        # One cache per learner: jitted fns close over the module; data
+        # exports materialize Arrow -> numpy once, not once per round.
         self._train_epoch_fn: Optional[Callable] = None
         self._eval_fn: Optional[Callable] = None
+        self._train_batches: Optional[Any] = None
+        self._eval_arrays: Optional[tuple] = None
+
+    def set_data(self, data: TpflDataset) -> None:
+        super().set_data(data)
+        self._train_batches = None
+        self._eval_arrays = None
 
     # --- jitted program builders ---
 
@@ -122,7 +137,7 @@ class JaxLearner(Learner):
 
             def loss_of(params):
                 logits, new_aux = apply(params, state.aux_state, x, True)
-                return loss_fn(logits, y), (logits, new_aux)
+                return loss_fn(logits, y).mean(), (logits, new_aux)
 
             (loss, (logits, new_aux)), grads = jax.value_and_grad(
                 loss_of, has_aux=True
@@ -149,6 +164,7 @@ class JaxLearner(Learner):
         batches and a 0/1 sample mask keeps padding out of every metric,
         so one compiled shape covers any test-set size."""
         module = self._module()
+        loss_fn = self._loss_fn
 
         @jax.jit
         def eval_batches(params, aux, xs, ys, ms):
@@ -156,9 +172,7 @@ class JaxLearner(Learner):
                 x, y, m = batch
                 variables = {"params": params, **(aux or {})}
                 logits = module.apply(variables, x, train=False)
-                losses = optax.softmax_cross_entropy_with_integer_labels(
-                    logits, y
-                )
+                losses = loss_fn(logits, y)
                 preds = jnp.argmax(logits, -1)
                 cm = jnp.zeros((n_classes, n_classes), jnp.int32).at[
                     y, preds
@@ -175,11 +189,12 @@ class JaxLearner(Learner):
 
     # --- data ---
 
-    def _stacked(self, train: bool, epoch_seed: int):
-        batches = self.get_data().export(
-            batch_size=self.batch_size, train=train, seed=epoch_seed
-        )
-        return batches
+    def _train_data(self, epoch_seed: int):
+        if self._train_batches is None:
+            self._train_batches = self.get_data().export(
+                batch_size=self.batch_size, train=True, seed=epoch_seed
+            )
+        return self._train_batches
 
     # --- Learner API ---
 
@@ -211,13 +226,17 @@ class JaxLearner(Learner):
         for cb in self.callbacks:
             c = cb.grad_correction(initial_params)
             if c is not None:
-                correction = c
+                correction = (
+                    c
+                    if correction is None
+                    else jax.tree_util.tree_map(jnp.add, correction, c)
+                )
         if correction is None:
             correction = jax.tree_util.tree_map(
                 lambda p: jnp.zeros((), p.dtype), initial_params
             )
 
-        batches = self._stacked(True, base_seed)
+        batches = self._train_data(base_seed)
         in_exp = self._in_experiment()
         n_steps = 0
         for epoch in range(self.epochs):
@@ -272,21 +291,28 @@ class JaxLearner(Learner):
         data = self.get_data()
         if data.num_samples(False) == 0:
             return {}
-        batches = data.export(
-            batch_size=self.batch_size, train=False, drop_remainder=False
-        )
-        # Pad to full batches with a sample mask so the compiled shape is
-        # independent of the test-set size and no tail sample is dropped.
-        x, y = batches.x, batches.y
-        bs = batches.batch_size
-        n_batches = -(-len(x) // bs)
-        pad = n_batches * bs - len(x)
-        mask = np.concatenate([np.ones(len(x), np.int32), np.zeros(pad, np.int32)])
-        x = np.concatenate([x, np.zeros((pad, *x.shape[1:]), x.dtype)])
-        y = np.concatenate([y, np.zeros(pad, y.dtype)])
-        xs = x.reshape(n_batches, bs, *x.shape[1:])
-        ys = y.reshape(n_batches, bs)
-        ms = mask.reshape(n_batches, bs)
+        if self._eval_arrays is None:
+            batches = data.export(
+                batch_size=self.batch_size, train=False, drop_remainder=False
+            )
+            # Pad to full batches with a sample mask so the compiled
+            # shape is independent of the test-set size and no tail
+            # sample is dropped.
+            x, y = batches.x, batches.y
+            bs = batches.batch_size
+            n_batches = -(-len(x) // bs)
+            pad = n_batches * bs - len(x)
+            mask = np.concatenate(
+                [np.ones(len(x), np.int32), np.zeros(pad, np.int32)]
+            )
+            x = np.concatenate([x, np.zeros((pad, *x.shape[1:]), x.dtype)])
+            y = np.concatenate([y, np.zeros(pad, y.dtype)])
+            self._eval_arrays = (
+                x.reshape(n_batches, bs, *x.shape[1:]),
+                y.reshape(n_batches, bs),
+                mask.reshape(n_batches, bs),
+            )
+        xs, ys, ms = self._eval_arrays
         if self._eval_fn is None:
             aux = model.aux_state or {}
             logits_shape = jax.eval_shape(

@@ -1,0 +1,213 @@
+"""Node — the composition root of one FL participant.
+
+Parity with reference ``p2pfl/node.py:57-413``: wires protocol + learner
++ aggregator + commands (ctor, reference :89-134), exposes
+``connect/disconnect`` (:140-184), ``start/stop`` (:210-253), and
+``set_start_learning`` (:342-372) which broadcasts StartLearning +
+ModelInitialized and spawns the daemon learning thread running the stage
+workflow (:333-400).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import zlib
+from typing import Any, Optional, Type
+
+from tpfl.communication.commands import ALL_COMMANDS, StartLearningCommand
+from tpfl.communication.memory import InMemoryCommunicationProtocol
+from tpfl.communication.protocol import CommunicationProtocol
+from tpfl.exceptions import (
+    LearnerRunningException,
+    NodeRunningException,
+    ZeroRoundsException,
+)
+from tpfl.learning.aggregators import FedAvg
+from tpfl.learning.aggregators.aggregator import Aggregator
+from tpfl.learning.dataset.tpfl_dataset import TpflDataset
+from tpfl.learning.jax_learner import JaxLearner
+from tpfl.learning.learner import Learner
+from tpfl.learning.model import TpflModel
+from tpfl.management.logger import logger
+from tpfl.settings import Settings
+from tpfl.stages.stage import LearningWorkflow
+
+
+class Node:
+    """One FL participant: model + data + transport + aggregator.
+
+    Args:
+        model: initial TpflModel (flax module + params).
+        data: local dataset partition.
+        addr: optional explicit address (transports auto-assign).
+        protocol: CommunicationProtocol class or instance.
+        learner: Learner class or instance.
+        aggregator: Aggregator instance (default FedAvg).
+        simulation: mark the node as simulated (logger bookkeeping).
+        **learner_kwargs: forwarded to the learner constructor
+            (learning_rate, batch_size, ...).
+    """
+
+    def __init__(
+        self,
+        model: TpflModel,
+        data: TpflDataset,
+        addr: Optional[str] = None,
+        protocol: Type[CommunicationProtocol] | CommunicationProtocol = InMemoryCommunicationProtocol,
+        learner: Type[Learner] | Learner = JaxLearner,
+        aggregator: Optional[Aggregator] = None,
+        simulation: bool = False,
+        **learner_kwargs: Any,
+    ) -> None:
+        if isinstance(protocol, CommunicationProtocol):
+            self.communication = protocol
+        else:
+            self.communication = protocol(addr) if addr else protocol()
+        self.addr = self.communication.get_address()
+
+        from tpfl.node_state import NodeState
+
+        self.state = NodeState(self.addr, simulation=simulation)
+        self.aggregator = aggregator if aggregator is not None else FedAvg()
+        self.aggregator.node_name = self.addr
+
+        if isinstance(learner, Learner):
+            self.learner = learner
+            self.learner.set_addr(self.addr)
+            self.learner.set_model(model)
+            self.learner.set_data(data)
+        else:
+            self.learner = learner(
+                model=model,
+                data=data,
+                addr=self.addr,
+                aggregator=self.aggregator,
+                **learner_kwargs,
+            )
+
+        # Experiment parameters (set by set_start_learning / command)
+        self.rounds: int = 0
+        self.epochs: int = 1
+        self.learning_workflow = LearningWorkflow()
+        self._learning_thread: Optional[threading.Thread] = None
+        self._running = False
+        self.rng = random.Random((Settings.SEED or 0) + zlib.crc32(self.addr.encode()))
+
+        # Register application verbs (reference node.py:122-134).
+        for cmd_cls in ALL_COMMANDS:
+            cmd = cmd_cls(self)
+            self.communication.add_command(cmd.get_name(), cmd.execute)
+
+    # --- lifecycle (reference node.py:210-253) ---
+
+    def start(self, wait: bool = False) -> None:
+        if self._running:
+            raise NodeRunningException(f"Node {self.addr} already running")
+        logger.register_node(self.addr, simulation=self.state.simulation)
+        self.communication.start()
+        self._running = True
+        logger.info(self.addr, "Node started")
+        if wait:
+            self.communication.wait_for_termination()
+            logger.unregister_node(self.addr)
+
+    def stop(self) -> None:
+        if not self._running:
+            return
+        if self.state.status == "Learning":
+            self.stop_learning()
+        self.communication.stop()
+        logger.unregister_node(self.addr)
+        self._running = False
+        logger.info(self.addr, "Node stopped")
+
+    # --- topology (reference node.py:140-184) ---
+
+    def connect(self, addr: str) -> bool:
+        if not self._running:
+            raise NodeRunningException("Node must be started to connect")
+        return self.communication.connect(addr)
+
+    def disconnect(self, addr: str) -> None:
+        self.communication.disconnect(addr)
+
+    def get_neighbors(self, only_direct: bool = False) -> dict[str, Any]:
+        return self.communication.get_neighbors(only_direct)
+
+    # --- learning (reference node.py:333-400) ---
+
+    def set_start_learning(self, rounds: int = 1, epochs: int = 1) -> None:
+        """Kick off a federated experiment from this node."""
+        if not self._running:
+            raise NodeRunningException("Node must be started")
+        if rounds < 1:
+            raise ZeroRoundsException("rounds must be >= 1")
+        if self.state.status == "Learning":
+            raise LearnerRunningException("Already learning")
+        self.communication.broadcast(
+            self.communication.build_msg(
+                StartLearningCommand.name, [str(rounds), str(epochs)]
+            )
+        )
+        # Initiator has the weights: release its own init event and
+        # announce (reference node.py:362-368).
+        self.state.model_initialized_event.set()
+        from tpfl.communication.commands import ModelInitializedCommand
+
+        self.communication.broadcast(
+            self.communication.build_msg(ModelInitializedCommand.name)
+        )
+        self.start_learning_thread(rounds, epochs)
+
+    def start_learning_thread(self, rounds: int, epochs: int) -> None:
+        """Spawn the stage-workflow thread (also the StartLearningCommand
+        entry point for non-initiator nodes)."""
+        if self._learning_thread is not None and self._learning_thread.is_alive():
+            logger.debug(self.addr, "Learning thread already running")
+            return
+        self.rounds = rounds
+        self.epochs = epochs
+        self.state.prepare_experiment()
+        self.learning_workflow = LearningWorkflow()
+        self._learning_thread = threading.Thread(
+            target=self._run_workflow,
+            daemon=True,
+            name=f"learning-{self.addr}",
+        )
+        self._learning_thread.start()
+
+    def _run_workflow(self) -> None:
+        try:
+            self.learning_workflow.run(self)
+        except Exception as e:  # pragma: no cover - last-resort guard
+            logger.error(self.addr, f"Learning workflow crashed: {e}")
+            import traceback
+
+            logger.error(self.addr, traceback.format_exc())
+            self.learning_workflow.finished = True
+
+    def stop_learning(self) -> None:
+        """Abort the experiment (reference stop_learning_command path).
+
+        Order matters: mark the state idle FIRST (early-stop predicate
+        becomes true), then set the events so blocked stages wake and
+        observe it. Full bookkeeping reset happens on the next
+        ``start_learning_thread`` (prepare_experiment)."""
+        logger.info(self.addr, "Stopping learning")
+        self.learner.interrupt_fit()
+        st = self.state
+        st.status = "Idle"
+        st.experiment = None
+        st.model_initialized_event.set()
+        st.aggregated_model_event.set()
+        st.votes_ready_event.set()
+        self.aggregator.clear()
+
+    # --- introspection ---
+
+    def learning_finished(self) -> bool:
+        return self.learning_workflow.finished
+
+    def __repr__(self) -> str:
+        return f"Node({self.addr}, running={self._running})"

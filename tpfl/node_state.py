@@ -1,0 +1,110 @@
+"""NodeState — all mutable per-node learning state.
+
+Parity with reference ``p2pfl/node_state.py:26-127``: the dicts/events
+here are the synchronization points between protocol handler threads
+(commands mutating state on message arrival) and the learning thread
+(stages blocking on events). The reference uses raw ``threading.Lock``
+acquire/release pairs as signals; here they are ``threading.Event``s,
+which express the same handoffs without the acquire-twice idiom.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from tpfl.experiment import Experiment
+
+
+class NodeState:
+    def __init__(self, addr: str, simulation: bool = False) -> None:
+        self.addr = addr
+        self.simulation = simulation
+        self.status: str = "Idle"
+        self.experiment: Optional[Experiment] = None
+
+        # Voting (reference vote_train_set_command.py / stage).
+        # Votes are tagged with the voter's round: a fast peer's round-r+1
+        # vote arriving while we are still in round r must survive our
+        # round-r tally and cleanup (the tally filters by round).
+        self.train_set: list[str] = []
+        self.train_set_votes: dict[str, tuple[int, dict[str, int]]] = {}
+        self.train_set_votes_lock = threading.Lock()
+        self.votes_ready_event = threading.Event()
+
+        # Model lifecycle events
+        self.model_initialized_event = threading.Event()
+        self.aggregated_model_event = threading.Event()
+        self.last_full_model_round: int = -1
+        """Highest round for which a FullModel was received/produced —
+        compared against the current round by WaitAggregatedModelsStage
+        (event-only signalling can lose an early-arriving FullModel)."""
+
+        # Gossip bookkeeping
+        self.models_aggregated: dict[str, list[str]] = {}
+        self.models_aggregated_lock = threading.Lock()
+        self.nei_status: dict[str, int] = {}  # addr -> last finished round (-1 = model initialized)
+
+    # --- experiment delegation (reference node_state.py:84-97) ---
+
+    @property
+    def round(self) -> Optional[int]:
+        return self.experiment.round if self.experiment else None
+
+    @property
+    def total_rounds(self) -> Optional[int]:
+        return self.experiment.total_rounds if self.experiment else None
+
+    @property
+    def exp_name(self) -> Optional[str]:
+        return self.experiment.exp_name if self.experiment else None
+
+    def set_experiment(self, experiment: Experiment) -> None:
+        self.status = "Learning"
+        self.experiment = experiment
+
+    def increase_round(self) -> None:
+        if self.experiment is None:
+            raise ValueError("No experiment running")
+        self.experiment.increase_round()
+        with self.models_aggregated_lock:
+            self.models_aggregated = {}
+
+    def set_models_aggregated(self, node: str, models: list[str]) -> None:
+        with self.models_aggregated_lock:
+            self.models_aggregated[node] = models
+
+    def get_models_aggregated(self) -> dict[str, list[str]]:
+        with self.models_aggregated_lock:
+            return dict(self.models_aggregated)
+
+    def prepare_experiment(self) -> None:
+        """Reset per-experiment bookkeeping before the learning thread
+        spawns. Preserves ``model_initialized_event`` and ``nei_status``
+        — the initiator (or an early InitModel/ModelInitialized command)
+        may legitimately arrive before the thread starts."""
+        with self.train_set_votes_lock:
+            self.train_set_votes = {}
+        with self.models_aggregated_lock:
+            self.models_aggregated = {}
+        self.train_set = []
+        self.last_full_model_round = -1
+        self.votes_ready_event.clear()
+        self.aggregated_model_event.clear()
+
+    def clear(self) -> None:
+        """Reset to idle (reference node_state.py:125-127). Event
+        *objects* are preserved (only cleared): stage threads blocked on
+        them must keep waiting on the same object a stop/command will
+        set."""
+        self.status = "Idle"
+        self.experiment = None
+        self.prepare_experiment()
+        self.nei_status = {}
+        self.model_initialized_event.clear()
+
+    def __repr__(self) -> str:
+        return (
+            f"NodeState(addr={self.addr}, status={self.status}, "
+            f"round={self.round}, train_set={self.train_set})"
+        )

@@ -1,0 +1,11 @@
+"""Round-protocol stage FSM (reference ``p2pfl/stages/``).
+
+Stage graph (reference docs/source/components/workflows.md:14-23)::
+
+    StartLearning → Vote → (Train | WaitAggregatedModels)
+                  → GossipModel → RoundFinished → (Vote | done)
+"""
+
+from tpfl.stages.stage import Stage, StageWorkflow, LearningWorkflow
+
+__all__ = ["Stage", "StageWorkflow", "LearningWorkflow"]

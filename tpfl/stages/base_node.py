@@ -1,0 +1,353 @@
+"""The six FL round stages (reference ``p2pfl/stages/base_node/``).
+
+Call-stack parity: SURVEY §3.2. Synchronization-point differences from
+the reference (each fixes a reference wart without changing semantics):
+
+- the aggregated-model handoff is tracked as ``state.last_full_model_round``
+  compared against the current round instead of a bare event cleared at
+  stage entry (the reference can lose a FullModel that arrives before
+  ``WaitAggregatedModelsStage`` clears the event, wait_agg_models_stage.py:47-50);
+- vote weights and gossip peer sampling derive from seeded RNGs for
+  reproducible simulations.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import TYPE_CHECKING, Optional, Type
+
+from tpfl.communication.commands import (
+    FullModelCommand,
+    InitModelCommand,
+    MetricsCommand,
+    ModelsAggregatedCommand,
+    ModelsReadyCommand,
+    PartialModelCommand,
+    VoteTrainSetCommand,
+)
+from tpfl.experiment import Experiment
+from tpfl.learning.aggregators.aggregator import NoModelsToAggregateError
+from tpfl.management.logger import logger
+from tpfl.settings import Settings
+from tpfl.stages.stage import Stage, check_early_stop
+
+if TYPE_CHECKING:
+    from tpfl.node import Node
+
+
+class StartLearningStage(Stage):
+    """Reference start_learning_stage.py:35-112."""
+
+    name = "StartLearningStage"
+
+    @staticmethod
+    def execute(node: "Node") -> Optional[Type[Stage]]:
+        st = node.state
+        st.set_experiment(Experiment("experiment", node.rounds))
+        logger.experiment_started(node.addr, st.experiment)
+        node.learner.set_epochs(node.epochs)
+
+        # Wait for weights: released locally by set_start_learning (the
+        # initiator) or by an incoming InitModelCommand.
+        while not st.model_initialized_event.wait(timeout=0.1):
+            if check_early_stop(node):
+                return None
+
+        # Diffuse initial weights to direct neighbors that have not
+        # announced a model yet (reference :81-112).
+        def candidates() -> list[str]:
+            return [
+                n
+                for n in node.communication.get_neighbors(only_direct=True)
+                if n not in st.nei_status
+            ]
+
+        node.communication.gossip_weights(
+            early_stopping_fn=lambda: check_early_stop(node),
+            get_candidates_fn=candidates,
+            status_fn=lambda: sorted(st.nei_status),
+            model_fn=lambda nei: node.communication.build_weights(
+                InitModelCommand.name,
+                st.round if st.round is not None else 0,
+                node.learner.get_model().encode_parameters(),
+            ),
+        )
+        time.sleep(Settings.WAIT_HEARTBEATS_CONVERGENCE)
+        return VoteTrainSetStage
+
+
+class VoteTrainSetStage(Stage):
+    """Reference vote_train_set_stage.py:34-184."""
+
+    name = "VoteTrainSetStage"
+
+    @staticmethod
+    def execute(node: "Node") -> Optional[Type[Stage]]:
+        st = node.state
+        if check_early_stop(node):
+            return None
+        candidates = list(node.communication.get_neighbors()) + [node.addr]
+
+        # Cast my vote: sample ≤ TRAIN_SET_SIZE candidates with random
+        # weights (reference :79-107), seeded per node for determinism.
+        sample = node.rng.sample(
+            candidates, min(Settings.TRAIN_SET_SIZE, len(candidates))
+        )
+        weights = [node.rng.randint(0, 1000) for _ in sample]
+        my_votes = dict(zip(sample, weights))
+        with st.train_set_votes_lock:
+            st.train_set_votes[node.addr] = (st.round or 0, my_votes)
+        flat: list[str] = []
+        for c, w in my_votes.items():
+            flat += [c, str(w)]
+        node.communication.broadcast(
+            node.communication.build_msg(
+                VoteTrainSetCommand.name, flat, round=st.round
+            )
+        )
+
+        # Tally once all live candidates voted or VOTE_TIMEOUT
+        # (reference :109-171).
+        deadline = time.time() + Settings.VOTE_TIMEOUT
+        while time.time() < deadline:
+            if check_early_stop(node):
+                return None
+            with st.train_set_votes_lock:
+                voters = {
+                    src
+                    for src, (rnd, _) in st.train_set_votes.items()
+                    if rnd == st.round
+                }
+            alive = set(node.communication.get_neighbors()) | {node.addr}
+            if alive - voters == set():
+                break
+            st.votes_ready_event.wait(timeout=0.1)
+            st.votes_ready_event.clear()
+        else:
+            logger.warning(node.addr, "Vote timeout; tallying what arrived")
+
+        with st.train_set_votes_lock:
+            all_votes = [
+                dict(votes)
+                for (rnd, votes) in st.train_set_votes.values()
+                if rnd == st.round
+            ]
+        tally: dict[str, int] = {}
+        for votes in all_votes:
+            for cand, w in votes.items():
+                tally[cand] = tally.get(cand, 0) + int(w)
+        ranked = sorted(tally.items(), key=lambda kv: (-kv[1], kv[0]))
+        train_set = [c for c, _ in ranked[: Settings.TRAIN_SET_SIZE]]
+
+        # Drop dead candidates (reference :173-184).
+        alive = set(node.communication.get_neighbors()) | {node.addr}
+        st.train_set = [c for c in train_set if c in alive]
+        logger.info(node.addr, f"Train set: {st.train_set}")
+
+        if check_early_stop(node):
+            return None
+        return TrainStage if node.addr in st.train_set else WaitAggregatedModelsStage
+
+
+class TrainStage(Stage):
+    """Reference train_stage.py:35-176."""
+
+    name = "TrainStage"
+
+    @staticmethod
+    def execute(node: "Node") -> Optional[Type[Stage]]:
+        st = node.state
+        node.aggregator.set_nodes_to_aggregate(st.train_set)
+
+        TrainStage._evaluate(node)
+        if check_early_stop(node):
+            node.aggregator.clear()
+            return None
+
+        logger.info(node.addr, f"Training (round {st.round})")
+        node.learner.fit()
+        if check_early_stop(node):
+            node.aggregator.clear()
+            return None
+
+        covered = node.aggregator.add_model(node.learner.get_model())
+        st.set_models_aggregated(node.addr, covered)
+        node.communication.broadcast(
+            node.communication.build_msg(
+                ModelsAggregatedCommand.name, covered, round=st.round
+            )
+        )
+
+        # Gossip partial aggregates to train-set peers still missing
+        # contributors (reference :119-176; create_connection=True fully
+        # connects the train set).
+        full = set(st.train_set)
+
+        def early_stop() -> bool:
+            if check_early_stop(node):
+                return True
+            # Everyone (including us) covers the full train set.
+            agg = st.get_models_aggregated()
+            return all(set(agg.get(n, [])) >= full for n in st.train_set)
+
+        def candidates() -> list[str]:
+            agg = st.get_models_aggregated()
+            return [
+                n
+                for n in st.train_set
+                if n != node.addr and not set(agg.get(n, [])) >= full
+            ]
+
+        def model_for(nei: str) -> Optional[object]:
+            known = st.get_models_aggregated().get(nei, [])
+            model = node.aggregator.get_model(except_nodes=list(known))
+            if model is None:
+                return None
+            return node.communication.build_weights(
+                PartialModelCommand.name,
+                st.round,
+                model.encode_parameters(),
+                contributors=model.get_contributors(),
+                num_samples=model.get_num_samples(),
+            )
+
+        node.communication.gossip_weights(
+            early_stopping_fn=early_stop,
+            get_candidates_fn=candidates,
+            status_fn=lambda: sorted(
+                (k, tuple(sorted(v))) for k, v in st.get_models_aggregated().items()
+            ),
+            model_fn=model_for,
+            create_connection=True,
+        )
+        if check_early_stop(node):
+            node.aggregator.clear()
+            return None
+
+        try:
+            agg_model = node.aggregator.wait_and_get_aggregation()
+        except NoModelsToAggregateError:
+            logger.error(node.addr, "Nothing aggregated this round")
+            return GossipModelStage
+        node.learner.set_model(agg_model)
+        st.last_full_model_round = st.round if st.round is not None else -1
+        node.communication.broadcast(
+            node.communication.build_msg(
+                ModelsReadyCommand.name, [], round=st.round
+            )
+        )
+        return GossipModelStage
+
+    @staticmethod
+    def _evaluate(node: "Node") -> None:
+        """Eval + metric gossip (reference train_stage.py:102-117)."""
+        metrics = node.learner.evaluate()
+        if not metrics:
+            return
+        flat: list[str] = []
+        for k, v in metrics.items():
+            flat += [k, str(v)]
+        node.communication.broadcast(
+            node.communication.build_msg(
+                MetricsCommand.name, flat, round=node.state.round
+            )
+        )
+
+
+class WaitAggregatedModelsStage(Stage):
+    """Reference wait_agg_models_stage.py:31-67."""
+
+    name = "WaitAggregatedModelsStage"
+
+    @staticmethod
+    def execute(node: "Node") -> Optional[Type[Stage]]:
+        st = node.state
+        deadline = time.time() + Settings.AGGREGATION_TIMEOUT
+        while time.time() < deadline:
+            if check_early_stop(node):
+                return None
+            if st.round is not None and st.last_full_model_round >= st.round:
+                break
+            st.aggregated_model_event.wait(timeout=0.1)
+            st.aggregated_model_event.clear()
+        else:
+            logger.warning(node.addr, "Aggregation wait timed out")
+        node.communication.broadcast(
+            node.communication.build_msg(
+                ModelsReadyCommand.name, [], round=st.round
+            )
+        )
+        return GossipModelStage
+
+
+class GossipModelStage(Stage):
+    """Full-model diffusion (reference gossip_model_stage.py:32-87)."""
+
+    name = "GossipModelStage"
+
+    @staticmethod
+    def execute(node: "Node") -> Optional[Type[Stage]]:
+        st = node.state
+
+        def candidates() -> list[str]:
+            if st.round is None:
+                return []
+            return [
+                n
+                for n in node.communication.get_neighbors(only_direct=True)
+                if st.nei_status.get(n, -1) < st.round
+            ]
+
+        def model_for(nei: str) -> Optional[object]:
+            model = node.learner.get_model()
+            try:
+                contributors = model.get_contributors()
+            except ValueError:
+                contributors = [node.addr]
+            return node.communication.build_weights(
+                FullModelCommand.name,
+                st.round if st.round is not None else 0,
+                model.encode_parameters(),
+                contributors=contributors,
+                num_samples=model.get_num_samples(),
+            )
+
+        node.communication.gossip_weights(
+            early_stopping_fn=lambda: check_early_stop(node) or not candidates(),
+            get_candidates_fn=candidates,
+            status_fn=lambda: sorted(st.nei_status.items()),
+            model_fn=model_for,
+        )
+        return RoundFinishedStage
+
+
+class RoundFinishedStage(Stage):
+    """Reference round_finished_stage.py:33-74."""
+
+    name = "RoundFinishedStage"
+
+    @staticmethod
+    def execute(node: "Node") -> Optional[Type[Stage]]:
+        st = node.state
+        if check_early_stop(node):
+            return None
+        node.aggregator.clear()
+        # Keep train_set_votes: next-round votes may already be in it
+        # (round-tagged entries are filtered at tally time).
+        st.votes_ready_event.clear()
+        st.increase_round()
+        logger.round_finished(node.addr)
+        logger.info(
+            node.addr,
+            f"Round {st.round - 1 if st.round else '?'} finished "
+            f"({st.round}/{st.total_rounds})",
+        )
+
+        if st.round is not None and st.total_rounds is not None and st.round < st.total_rounds:
+            return VoteTrainSetStage
+
+        # Experiment done: final eval, back to idle (reference :66-74).
+        TrainStage._evaluate(node)
+        logger.experiment_finished(node.addr)
+        st.clear()
+        return None

@@ -1,0 +1,18 @@
+"""User-facing utilities: topologies, convergence waits, model checks."""
+
+from tpfl.utils.topologies import TopologyFactory, TopologyType
+from tpfl.utils.utils import (
+    check_equal_models,
+    full_connection,
+    wait_convergence,
+    wait_to_finish,
+)
+
+__all__ = [
+    "TopologyFactory",
+    "TopologyType",
+    "wait_convergence",
+    "wait_to_finish",
+    "full_connection",
+    "check_equal_models",
+]

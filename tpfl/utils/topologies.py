@@ -1,0 +1,49 @@
+"""Network topologies (reference ``p2pfl/utils/topologies.py:30-93``):
+STAR/FULL/LINE/RING adjacency matrices + connection walker."""
+
+from __future__ import annotations
+
+from enum import Enum
+from typing import Sequence
+
+import numpy as np
+
+
+class TopologyType(Enum):
+    STAR = "star"
+    FULL = "full"
+    LINE = "line"
+    RING = "ring"
+
+
+class TopologyFactory:
+    @staticmethod
+    def generate_matrix(topology: TopologyType, n: int) -> np.ndarray:
+        m = np.zeros((n, n), dtype=int)
+        if topology == TopologyType.STAR:
+            m[0, 1:] = 1
+            m[1:, 0] = 1
+        elif topology == TopologyType.FULL:
+            m[:] = 1
+            np.fill_diagonal(m, 0)
+        elif topology == TopologyType.LINE:
+            idx = np.arange(n - 1)
+            m[idx, idx + 1] = 1
+            m[idx + 1, idx] = 1
+        elif topology == TopologyType.RING:
+            idx = np.arange(n)
+            m[idx, (idx + 1) % n] = 1
+            m[(idx + 1) % n, idx] = 1
+        else:
+            raise ValueError(f"Unknown topology {topology}")
+        return m
+
+    @staticmethod
+    def connect_nodes(matrix: np.ndarray, nodes: Sequence) -> None:
+        """Walk the upper triangle and connect (reference
+        topologies.py:74-93)."""
+        n = len(nodes)
+        for i in range(n):
+            for j in range(i + 1, n):
+                if matrix[i, j]:
+                    nodes[i].connect(nodes[j].addr)
