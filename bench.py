@@ -1,0 +1,91 @@
+"""Benchmark: FedAvg rounds/sec + samples/sec/chip, CIFAR-10 CNN, 100 nodes.
+
+The driver-defined north-star (BASELINE.json): a 100-node FedAvg CIFAR-10
+federation. The reference (p2pfl) runs each node as a Ray-actor process
+with pickled-numpy weight exchange and publishes no numbers; its
+implicit envelope is the test/example budget (2-node 2-round MNIST in
+≤ 240 s, examples ≤ 3600 s — BASELINE.md). Here one full federated
+round (100 nodes × 1 local epoch + exact FedAvg) is a single XLA
+program on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+``value`` = local-epoch samples/sec/chip across the federation;
+``vs_baseline`` = measured rounds/sec over the reference envelope's
+implied floor (2 rounds / 240 s = 0.00833 rounds/s, the only
+quantitative anchor the reference provides).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpfl.models import CNN
+    from tpfl.parallel import VmapFederation
+
+    n_chips = len(jax.devices())
+    # Node count must divide over the mesh; 100 on one chip (the
+    # BASELINE.json config), nearest multiple on a multi-chip host.
+    n_nodes = 100 if n_chips == 1 else (100 // n_chips) * n_chips
+    n_batches = 4
+    batch_size = 32
+    epochs = 1
+    samples_per_round = n_nodes * n_batches * batch_size * epochs
+
+    mesh = None
+    if n_chips > 1:
+        from tpfl.parallel import create_mesh
+
+        mesh = create_mesh({"nodes": n_chips})
+    fed = VmapFederation(
+        CNN(out_channels=10), n_nodes=n_nodes, mesh=mesh, learning_rate=0.1, seed=0
+    )
+    params = fed.init_params((32, 32, 3))
+    rng = np.random.default_rng(0)
+    xs = rng.normal(0.5, 0.25, size=(n_nodes, n_batches, batch_size, 32, 32, 3)).astype(
+        np.float32
+    )
+    ys = rng.integers(0, 10, size=(n_nodes, n_batches, batch_size)).astype(np.int32)
+    xs, ys = fed.shard_data(xs, ys)
+
+    # Warmup/compile (host readback = unambiguous sync point; on this
+    # platform block_until_ready has been observed returning early).
+    params, losses = fed.round(params, xs, ys, epochs=epochs)
+    float(np.asarray(losses).mean())
+
+    n_rounds = 10
+    t0 = time.perf_counter()
+    for _ in range(n_rounds):
+        params, losses = fed.round(params, xs, ys, epochs=epochs)
+    float(np.asarray(losses).mean())  # sync
+    dt = time.perf_counter() - t0
+
+    rounds_per_sec = n_rounds / dt
+    samples_per_sec_chip = rounds_per_sec * samples_per_round / n_chips
+
+    # Only quantitative anchor in the reference: 2-round MNIST e2e must
+    # fit in 240 s (node_test.py:105) -> 0.00833 rounds/s floor.
+    reference_floor_rounds_per_sec = 2.0 / 240.0
+
+    print(
+        json.dumps(
+            {
+                "metric": "fedavg_cifar10_cnn_100nodes_samples_per_sec_per_chip",
+                "value": round(samples_per_sec_chip, 1),
+                "unit": "samples/s/chip",
+                "vs_baseline": round(
+                    rounds_per_sec / reference_floor_rounds_per_sec, 1
+                ),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

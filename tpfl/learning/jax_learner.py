@@ -54,8 +54,15 @@ def _addr_seed(addr: str) -> int:
 
 def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
     """Per-sample loss vector [batch]; training takes the mean, masked
-    eval weights each sample — one definition serves both."""
+    eval weights each sample — one definition serves both. Canonical
+    loss for the whole framework (tpfl.parallel reuses it)."""
     return optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+
+
+def default_optimizer(lr: float) -> optax.GradientTransformation:
+    """Canonical local optimizer: SGD+momentum (adaptive optimizers'
+    parameter averages collapse under FedAvg — see JaxLearner docs)."""
+    return optax.sgd(lr, momentum=0.9)
 
 
 class JaxLearner(Learner):
@@ -88,9 +95,7 @@ class JaxLearner(Learner):
     ) -> None:
         super().__init__(model, data, addr, aggregator)
         self.learning_rate = float(learning_rate)
-        self._optimizer_factory = optimizer_factory or (
-            lambda lr: optax.sgd(lr, momentum=0.9)
-        )
+        self._optimizer_factory = optimizer_factory or default_optimizer
         self.batch_size = int(batch_size)
         self._loss_fn = loss_fn
         self._interrupt = threading.Event()

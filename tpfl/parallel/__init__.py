@@ -1,0 +1,29 @@
+"""TPU execution layer: meshes, vmapped federations, sharded training.
+
+This is the green-field value-add over the reference (SURVEY §2.10): the
+reference's only intra-host parallelism is a Ray actor pool multiplexing
+N learner *processes* over K CPUs (``actor_pool.py:69``), with weights
+round-tripping through pickle on every hop. Here:
+
+- :class:`VmapFederation` — N homogeneous FL nodes stacked on a leading
+  node axis; every node's local epoch runs inside ONE compiled XLA
+  program (vmap over lax.scan), the node axis is sharded over the device
+  mesh, and FedAvg is an exact on-device weighted reduction (XLA inserts
+  the all-reduce over ICI) instead of gossip-until-converged.
+- :func:`create_mesh` / :func:`federation_sharding` — mesh + sharding
+  helpers for single-host (8-chip) and multi-host topologies.
+- :class:`ShardedTrainer` — data-parallel + FSDP sharding for one large
+  model across the mesh (tpfl.parallel.sharded).
+"""
+
+from tpfl.parallel.mesh import create_mesh, federation_sharding, replicated
+from tpfl.parallel.federation import VmapFederation
+from tpfl.parallel.sharded import ShardedTrainer
+
+__all__ = [
+    "create_mesh",
+    "federation_sharding",
+    "replicated",
+    "VmapFederation",
+    "ShardedTrainer",
+]

@@ -1,0 +1,52 @@
+"""Device mesh helpers.
+
+The scaling recipe (jax-ml.github.io/scaling-book): pick a mesh,
+annotate shardings, let XLA insert collectives. Axes used by tpfl:
+
+- ``nodes`` — the federation axis: logical FL nodes sharded over chips
+  (VmapFederation). Collectives over it ride ICI.
+- ``dp`` / ``fsdp`` — batch / parameter sharding inside one learner
+  (ShardedTrainer).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def create_mesh(
+    axes: Optional[dict[str, int]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a Mesh from an axis-name -> size dict.
+
+    Defaults to one ``nodes`` axis over all local devices. Sizes must
+    multiply to the device count; a single -1 size is inferred.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    axes = dict(axes or {"nodes": len(devices)})
+    sizes = list(axes.values())
+    if sizes.count(-1) == 1:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = len(devices) // known
+        axes = dict(zip(axes.keys(), sizes))
+    total = int(np.prod(list(axes.values())))
+    if total != len(devices):
+        raise ValueError(
+            f"Mesh axes {axes} need {total} devices, have {len(devices)}"
+        )
+    dev_array = np.asarray(devices).reshape(*axes.values())
+    return Mesh(dev_array, tuple(axes.keys()))
+
+
+def federation_sharding(mesh: Mesh, axis: str = "nodes") -> NamedSharding:
+    """Sharding for node-stacked pytrees: leading axis over the mesh."""
+    return NamedSharding(mesh, PartitionSpec(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

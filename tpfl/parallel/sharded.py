@@ -1,0 +1,149 @@
+"""ShardedTrainer — dp/FSDP training of ONE large model over the mesh.
+
+The reference has no intra-learner parallelism at all (SURVEY §2.10:
+Lightning single-process, ``torch.set_num_threads(1)``). This is the
+TPU-idiomatic seam: a jitted train step whose batch is sharded over a
+``dp`` axis and (optionally) whose parameters/optimizer state are
+sharded FSDP-style; XLA inserts the gradient all-reduce / all-gather
+collectives over ICI. Plugs into a Learner via ``optimizer_factory`` /
+custom fit, or is used directly by benchmarks.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from tpfl.learning.jax_learner import cross_entropy_loss, default_optimizer
+
+
+def fsdp_spec(leaf: Any, axis: str, axis_size: int) -> PartitionSpec:
+    """Per-leaf FSDP heuristic: shard the largest divisible dim;
+    replicate small/indivisible leaves."""
+    shape = np.shape(leaf)
+    if not shape:
+        return PartitionSpec()
+    # Prefer the largest dimension divisible by the axis size.
+    order = sorted(range(len(shape)), key=lambda i: -shape[i])
+    for i in order:
+        if shape[i] % axis_size == 0 and shape[i] >= axis_size:
+            spec = [None] * len(shape)
+            spec[i] = axis
+            return PartitionSpec(*spec)
+    return PartitionSpec()
+
+
+class ShardedTrainer:
+    """Data-parallel (+ optional FSDP) single-model training.
+
+    Args:
+        module: flax module.
+        mesh: Mesh with a ``dp`` axis (at least).
+        fsdp: shard params/opt-state over the dp axis per-leaf.
+        learning_rate / optimizer_factory / loss_fn: as JaxLearner.
+    """
+
+    def __init__(
+        self,
+        module: Any,
+        mesh: Mesh,
+        fsdp: bool = False,
+        learning_rate: float = 0.1,
+        optimizer_factory: Optional[Callable] = None,
+        loss_fn: Optional[Callable] = None,
+        seed: int = 0,
+    ) -> None:
+        self.module = module
+        self.mesh = mesh
+        self.fsdp = fsdp
+        self.axis = "dp"
+        self._opt = (optimizer_factory or default_optimizer)(learning_rate)
+        self._loss_fn = loss_fn or cross_entropy_loss
+        self.seed = seed
+        self._step_fn: Optional[Callable] = None
+
+    # --- setup ---
+
+    def _param_sharding(self, params: Any) -> Any:
+        axis_size = self.mesh.shape[self.axis]
+        if self.fsdp:
+            return jax.tree_util.tree_map(
+                lambda p: NamedSharding(
+                    self.mesh, fsdp_spec(p, self.axis, axis_size)
+                ),
+                params,
+            )
+        return jax.tree_util.tree_map(
+            lambda p: NamedSharding(self.mesh, PartitionSpec()), params
+        )
+
+    def init(self, input_shape: tuple[int, ...]) -> tuple[Any, Any]:
+        """(params, opt_state), placed on the mesh."""
+        dummy = jnp.zeros((1, *input_shape), jnp.float32)
+        variables = self.module.init(
+            jax.random.PRNGKey(self.seed), dummy, train=False
+        )
+        extra = [k for k in variables if k != "params"]
+        if extra:
+            raise NotImplementedError(
+                f"ShardedTrainer does not yet thread mutable collections "
+                f"{extra} (e.g. BatchNorm stats); use JaxLearner for such "
+                f"models."
+            )
+        params = variables["params"]
+        params = jax.device_put(params, self._param_sharding(params))
+        opt_state = self._opt.init(params)
+        return params, opt_state
+
+    def shard_batch(self, x: Any, y: Any) -> tuple[Any, Any]:
+        """Shard the batch dimension over dp."""
+        sh = NamedSharding(self.mesh, PartitionSpec(self.axis))
+        return jax.device_put(jnp.asarray(x), sh), jax.device_put(
+            jnp.asarray(y), sh
+        )
+
+    # --- step ---
+
+    def _build_step(self, params: Any) -> Callable:
+        module = self.module
+        loss_fn = self._loss_fn
+        opt = self._opt
+        param_sh = self._param_sharding(params)
+        batch_sh = NamedSharding(self.mesh, PartitionSpec(self.axis))
+
+        def step(params, opt_state, x, y):
+            def loss_of(p):
+                logits = module.apply({"params": p}, x, train=False)
+                return loss_fn(logits, y).mean()
+
+            loss, grads = jax.value_and_grad(loss_of)(params)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        # in/out shardings pin the layout; XLA inserts the collectives
+        # (grad all-reduce over dp; FSDP gather/scatter when params are
+        # sharded).
+        return jax.jit(
+            step,
+            donate_argnums=(0, 1),
+            in_shardings=(
+                param_sh,
+                None,  # opt state: let XLA mirror the param layout
+                batch_sh,
+                batch_sh,
+            ),
+            out_shardings=None,
+        )
+
+    def train_step(
+        self, params: Any, opt_state: Any, x: Any, y: Any
+    ) -> tuple[Any, Any, Any]:
+        if self._step_fn is None:
+            self._step_fn = self._build_step(params)
+        return self._step_fn(params, opt_state, x, y)
