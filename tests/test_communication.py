@@ -11,12 +11,13 @@ import time
 import pytest
 
 from tpfl.communication import InMemoryCommunicationProtocol
+from tpfl.communication.grpc_transport import GrpcCommunicationProtocol
 from tpfl.communication.memory import clear_registry
 from tpfl.communication.message import Message
 from tpfl.exceptions import CommunicationError
 from tpfl.settings import Settings
 
-PROTOCOLS = [InMemoryCommunicationProtocol]
+PROTOCOLS = [InMemoryCommunicationProtocol, GrpcCommunicationProtocol]
 
 
 @pytest.fixture(autouse=True)
@@ -52,8 +53,13 @@ def test_not_started_errors(protocol_class):
 @pytest.mark.parametrize("protocol_class", PROTOCOLS)
 def test_invalid_connect(protocol_class):
     (a,) = make_nodes(protocol_class, 1)
+    ghost = (
+        "ghost-address"
+        if protocol_class is InMemoryCommunicationProtocol
+        else "127.0.0.1:1"  # closed port
+    )
     assert not a.connect(a.get_address())  # self
-    assert not a.connect("ghost-address")  # unreachable
+    assert not a.connect(ghost)  # unreachable
     assert a.get_neighbors() == {}
     stop_all([a])
 

@@ -1,0 +1,304 @@
+"""Shared transport machinery.
+
+The reference's in-memory protocol is an admitted copy-paste of its gRPC
+twin (``memory_communication_protocol.py:35-37``). Here the common 90% —
+command dispatch, dedup, TTL re-flood, neighbor lifecycle, gossiper +
+heartbeater wiring, message building — lives in
+:class:`ThreadedCommunicationProtocol`; a transport only implements how
+to dial a peer and how to push one message down the wire.
+"""
+
+from __future__ import annotations
+
+import threading
+from abc import abstractmethod
+from typing import Any, Optional
+
+from tpfl.communication.gossiper import Gossiper
+from tpfl.communication.heartbeater import HEARTBEAT_CMD, Heartbeater
+from tpfl.communication.message import Message
+from tpfl.communication.neighbors import Neighbors
+from tpfl.communication.protocol import CommandHandler, CommunicationProtocol
+from tpfl.exceptions import CommunicationError, NeighborNotConnectedError
+from tpfl.management.logger import logger
+from tpfl.settings import Settings
+
+DISCONNECT_CMD = "_disconnect"
+
+
+class ThreadedCommunicationProtocol(CommunicationProtocol):
+    """Template transport: gossiper + heartbeater threads over a peer
+    table, with subclass hooks for the actual wire."""
+
+    def __init__(self, addr: str) -> None:
+        self._addr = addr
+        self._started = False
+        self._terminated = threading.Event()
+        self._commands: dict[str, CommandHandler] = {}
+        self._neighbors = Neighbors(
+            addr,
+            connect_fn=self._dial_and_handshake,
+            disconnect_fn=self._send_disconnect,
+            close_fn=self._close_conn,
+        )
+        self._gossiper = Gossiper(addr, self._gossip_send, self._neighbors.get_all)
+        self._heartbeater = Heartbeater(
+            addr, self._neighbors, self.broadcast, self.build_msg
+        )
+        self.add_command(HEARTBEAT_CMD, self._heartbeat_handler)
+        self.add_command(DISCONNECT_CMD, self._disconnect_handler)
+
+    # --- subclass hooks ---
+
+    @abstractmethod
+    def _dial(self, addr: str) -> Any:
+        """Open a transport connection to ``addr`` (no handshake)."""
+
+    @abstractmethod
+    def _handshake(self, addr: str, conn: Any) -> None:
+        """Tell the peer to add us as a direct neighbor."""
+
+    @abstractmethod
+    def _transport_send(self, addr: str, conn: Any, msg: Message) -> None:
+        """Push one message down an open connection."""
+
+    def _close_conn(self, conn: Any) -> None:
+        """Release a transport connection (default: nothing)."""
+
+    def _server_start(self) -> None:
+        """Bind/start the receiving side (default: nothing)."""
+
+    def _server_stop(self) -> None:
+        """Stop the receiving side (default: nothing)."""
+
+    # --- ABC surface ---
+
+    def get_address(self) -> str:
+        return self._addr
+
+    def start(self) -> None:
+        if self._started:
+            raise CommunicationError(f"{self._addr} already started")
+        self._server_start()
+        self._terminated.clear()
+        self._started = True
+        self._heartbeater.start()
+        self._gossiper.start()
+
+    def stop(self) -> None:
+        if not self._started:
+            return
+        self._heartbeater.stop()
+        self._gossiper.stop()
+        # Join before tearing down connections: a mid-flight broadcast
+        # would otherwise race the channel closes below.
+        for t in (self._heartbeater, self._gossiper):
+            if t.is_alive():
+                t.join(timeout=3)
+        self._neighbors.clear()
+        self._server_stop()
+        self._started = False
+        self._terminated.set()
+
+    def wait_for_termination(self) -> None:
+        self._terminated.wait()
+
+    def add_command(self, name: str, handler: CommandHandler) -> None:
+        self._commands[name] = handler
+
+    def connect(self, addr: str, non_direct: bool = False) -> bool:
+        if not self._started:
+            raise CommunicationError(f"{self._addr} not started")
+        if addr == self._addr:
+            logger.info(self._addr, "Cannot connect to self")
+            return False
+        if self._neighbors.exists(addr):
+            logger.info(self._addr, f"Already connected to {addr}")
+            return False
+        ok = self._neighbors.add(addr, non_direct=non_direct)
+        if not ok:
+            logger.info(self._addr, f"Cannot connect to {addr}")
+        return ok
+
+    def disconnect(self, addr: str, disconnect_msg: bool = True) -> None:
+        self._neighbors.remove(addr, disconnect_msg=disconnect_msg)
+
+    def build_msg(
+        self,
+        cmd: str,
+        args: Optional[list[str]] = None,
+        round: Optional[int] = None,
+    ) -> Message:
+        return Message(
+            source=self._addr,
+            cmd=cmd,
+            round=-1 if round is None else round,
+            args=[str(a) for a in (args or [])],
+            ttl=Settings.TTL,
+        ).new_hash()
+
+    def build_weights(
+        self,
+        cmd: str,
+        round: int,
+        serialized_model: bytes,
+        contributors: Optional[list[str]] = None,
+        num_samples: int = 0,
+    ) -> Message:
+        return Message(
+            source=self._addr,
+            cmd=cmd,
+            round=round,
+            payload=serialized_model,
+            contributors=list(contributors or []),
+            num_samples=num_samples,
+        )
+
+    def send(
+        self,
+        nei: str,
+        msg: Message,
+        create_connection: bool = False,
+        raise_error: bool = False,
+    ) -> None:
+        entry = self._neighbors.get(nei)
+        conn = entry.conn if entry is not None else None
+        ephemeral = False
+        if entry is not None and conn is None and entry.direct:
+            # Direct neighbor learned via server-side handshake (no
+            # back-channel yet): dial lazily and cache.
+            try:
+                conn = self._dial(nei)
+                entry.conn = conn
+            except Exception as e:
+                if raise_error:
+                    raise NeighborNotConnectedError(f"{nei} unreachable: {e}")
+                logger.debug(self._addr, f"Dial {nei} failed: {e}")
+                return
+        if entry is None or (conn is None and not entry.direct):
+            if not create_connection:
+                if raise_error:
+                    raise NeighborNotConnectedError(f"{nei} is not a neighbor")
+                logger.debug(self._addr, f"Not sending to non-neighbor {nei}")
+                return
+            try:
+                conn = self._dial(nei)
+                ephemeral = True
+            except Exception as e:
+                if raise_error:
+                    raise NeighborNotConnectedError(f"{nei} unreachable: {e}")
+                logger.debug(self._addr, f"Dial {nei} failed: {e}")
+                return
+        try:
+            self._transport_send(nei, conn, msg)
+        except Exception as e:
+            # On-send-error eviction (reference grpc_client.py:176-183).
+            self._neighbors.remove(nei)
+            if raise_error:
+                raise CommunicationError(f"Send to {nei} failed: {e}")
+            logger.debug(self._addr, f"Send to {nei} failed: {e}")
+        finally:
+            if ephemeral:
+                self._close_conn(conn)
+
+    def broadcast(self, msg: Message, node_list: Optional[list[str]] = None) -> None:
+        targets = node_list or list(self._neighbors.get_all(only_direct=True))
+        for nei in targets:
+            self.send(nei, msg)
+
+    def get_neighbors(self, only_direct: bool = False) -> dict[str, Any]:
+        return dict(self._neighbors.get_all(only_direct))
+
+    def gossip_weights(
+        self,
+        early_stopping_fn,
+        get_candidates_fn,
+        status_fn,
+        model_fn,
+        period: Optional[float] = None,
+        create_connection: bool = False,
+    ) -> None:
+        self._gossiper.gossip_weights(
+            early_stopping_fn,
+            get_candidates_fn,
+            status_fn,
+            model_fn,
+            period=period,
+            send_fn=lambda nei, msg: self.send(
+                nei, msg, create_connection=create_connection
+            ),
+        )
+
+    # --- internals shared by all transports ---
+
+    def _dial_and_handshake(self, addr: str) -> Any:
+        conn = self._dial(addr)
+        self._handshake(addr, conn)
+        return conn
+
+    def _send_disconnect(self, addr: str, conn: Any) -> None:
+        """Notify a peer we are leaving. ``conn`` (if any) is closed by
+        the caller (Neighbors.remove close hook); an ephemeral dial is
+        closed here."""
+        ephemeral = conn is None
+        try:
+            if conn is None:
+                conn = self._dial(addr)
+            self._transport_send(
+                addr, conn, Message(source=self._addr, cmd=DISCONNECT_CMD).new_hash()
+            )
+        except Exception:
+            pass
+        finally:
+            if ephemeral:
+                self._close_conn(conn)
+
+    def _disconnect_handler(self, source: str, **kwargs: Any) -> None:
+        self._neighbors.remove(source, disconnect_msg=False)
+
+    def _heartbeat_handler(self, source: str, args: list[str], **kwargs: Any) -> None:
+        self._heartbeater.beat(source, float(args[0]))
+
+    def _gossip_send(self, nei: str, msg: Message) -> None:
+        self.send(nei, msg)
+
+    def handle_message(self, msg: Message) -> None:
+        """Server receive path (reference grpc_server.py:161-215): dedup,
+        dispatch, TTL re-flood."""
+        if not self._started:
+            return
+        if not msg.is_weights:
+            if not self._gossiper.check_and_set_processed(msg.msg_hash):
+                return
+        handler = self._commands.get(msg.cmd)
+        if handler is None:
+            logger.error(
+                self._addr, f"Unknown command {msg.cmd!r} from {msg.source}"
+            )
+            return
+        try:
+            if msg.is_weights:
+                handler(
+                    source=msg.source,
+                    round=msg.round,
+                    weights=msg.payload,
+                    contributors=msg.contributors,
+                    num_samples=msg.num_samples,
+                )
+            else:
+                handler(source=msg.source, round=msg.round, args=msg.args)
+        except Exception as e:
+            logger.error(
+                self._addr, f"Command {msg.cmd} from {msg.source} failed: {e}"
+            )
+        if not msg.is_weights and msg.ttl > 1:
+            self._gossiper.add_message(
+                Message(
+                    source=msg.source,
+                    cmd=msg.cmd,
+                    round=msg.round,
+                    args=msg.args,
+                    ttl=msg.ttl - 1,
+                    msg_hash=msg.msg_hash,
+                )
+            )

@@ -1,12 +1,12 @@
 """In-memory transport — the zero-network protocol implementation.
 
-Parity with the reference's ``communication/protocols/memory/`` (which
-is an admitted copy-paste of its gRPC twin,
-``memory_communication_protocol.py:35-37``): a process-global address
-registry replaces the network; send = direct dispatch into the peer's
-handler in the caller's thread. The same Gossiper/Heartbeater/Neighbors
-machinery as the gRPC transport runs on top, so every protocol test
-exercises both transports identically (SURVEY §4 "three seams").
+Capability parity with the reference's ``communication/protocols/memory/``
+(``server_singleton.py`` + ``memory_server.py:137-204``), but NOT its
+copy-paste structure: all protocol logic lives in
+:class:`ThreadedCommunicationProtocol`; this class only maps "dial" to a
+process-global registry lookup and "send" to a direct call into the
+peer's handler (caller's thread). Every protocol test runs against both
+this and the gRPC transport (SURVEY §4 "three seams").
 """
 
 from __future__ import annotations
@@ -15,35 +15,13 @@ import itertools
 import threading
 from typing import Any, Optional
 
-from tpfl.communication.gossiper import Gossiper
-from tpfl.communication.heartbeater import HEARTBEAT_CMD, Heartbeater
+from tpfl.communication.base import ThreadedCommunicationProtocol
 from tpfl.communication.message import Message
-from tpfl.communication.neighbors import Neighbors
-from tpfl.communication.protocol import CommandHandler, CommunicationProtocol
-from tpfl.exceptions import CommunicationError, NeighborNotConnectedError
-from tpfl.management.logger import logger
-from tpfl.settings import Settings
+from tpfl.exceptions import CommunicationError
 
 _registry: dict[str, "InMemoryCommunicationProtocol"] = {}
 _registry_lock = threading.Lock()
 _addr_counter = itertools.count(1)
-
-
-def _register(addr: str, proto: "InMemoryCommunicationProtocol") -> None:
-    with _registry_lock:
-        if addr in _registry:
-            raise CommunicationError(f"Address {addr} already in use")
-        _registry[addr] = proto
-
-
-def _unregister(addr: str) -> None:
-    with _registry_lock:
-        _registry.pop(addr, None)
-
-
-def _lookup(addr: str) -> Optional["InMemoryCommunicationProtocol"]:
-    with _registry_lock:
-        return _registry.get(addr)
 
 
 def clear_registry() -> None:
@@ -52,228 +30,40 @@ def clear_registry() -> None:
         _registry.clear()
 
 
-class InMemoryCommunicationProtocol(CommunicationProtocol):
-    """Transport over a process-global registry (reference
-    ``server_singleton.py`` + ``memory_server.py:137-204``)."""
+def _lookup(addr: str) -> Optional["InMemoryCommunicationProtocol"]:
+    with _registry_lock:
+        return _registry.get(addr)
 
+
+class InMemoryCommunicationProtocol(ThreadedCommunicationProtocol):
     def __init__(self, addr: Optional[str] = None) -> None:
-        self._addr = addr or f"node-{next(_addr_counter)}"
-        self._started = False
-        self._terminated = threading.Event()
-        self._commands: dict[str, CommandHandler] = {}
-        self._neighbors = Neighbors(
-            self._addr,
-            connect_fn=self._make_connection,
-            disconnect_fn=self._send_disconnect,
-        )
-        self._gossiper = Gossiper(
-            self._addr, self._gossip_send, self._neighbors.get_all
-        )
-        self._heartbeater = Heartbeater(
-            self._addr, self._neighbors, self.broadcast, self.build_msg
-        )
-        self.add_command(HEARTBEAT_CMD, self._heartbeat_handler)
-        self.add_command("_disconnect", self._disconnect_handler)
+        super().__init__(addr or f"node-{next(_addr_counter)}")
 
-    # --- ABC surface ---
+    # --- transport hooks ---
 
-    def get_address(self) -> str:
-        return self._addr
+    def _server_start(self) -> None:
+        with _registry_lock:
+            if self._addr in _registry:
+                raise CommunicationError(f"Address {self._addr} already in use")
+            _registry[self._addr] = self
 
-    def start(self) -> None:
-        if self._started:
-            raise CommunicationError(f"{self._addr} already started")
-        _register(self._addr, self)
-        self._terminated.clear()
-        self._started = True
-        self._heartbeater.start()
-        self._gossiper.start()
+    def _server_stop(self) -> None:
+        with _registry_lock:
+            _registry.pop(self._addr, None)
 
-    def stop(self) -> None:
-        if not self._started:
-            return
-        self._heartbeater.stop()
-        self._gossiper.stop()
-        self._neighbors.clear()
-        _unregister(self._addr)
-        self._started = False
-        self._terminated.set()
-
-    def wait_for_termination(self) -> None:
-        self._terminated.wait()
-
-    def add_command(self, name: str, handler: CommandHandler) -> None:
-        self._commands[name] = handler
-
-    def connect(self, addr: str, non_direct: bool = False) -> bool:
-        if not self._started:
-            raise CommunicationError(f"{self._addr} not started")
-        if addr == self._addr:
-            logger.info(self._addr, "Cannot connect to self")
-            return False
-        if self._neighbors.exists(addr):
-            logger.info(self._addr, f"Already connected to {addr}")
-            return False
-        ok = self._neighbors.add(addr, non_direct=non_direct)
-        if not ok:
-            logger.info(self._addr, f"Cannot connect to {addr}")
-        return ok
-
-    def disconnect(self, addr: str, disconnect_msg: bool = True) -> None:
-        self._neighbors.remove(addr, disconnect_msg=disconnect_msg)
-
-    def build_msg(
-        self,
-        cmd: str,
-        args: Optional[list[str]] = None,
-        round: Optional[int] = None,
-    ) -> Message:
-        return Message(
-            source=self._addr,
-            cmd=cmd,
-            round=-1 if round is None else round,
-            args=[str(a) for a in (args or [])],
-            ttl=Settings.TTL,
-        ).new_hash()
-
-    def build_weights(
-        self,
-        cmd: str,
-        round: int,
-        serialized_model: bytes,
-        contributors: Optional[list[str]] = None,
-        num_samples: int = 0,
-    ) -> Message:
-        return Message(
-            source=self._addr,
-            cmd=cmd,
-            round=round,
-            payload=serialized_model,
-            contributors=list(contributors or []),
-            num_samples=num_samples,
-        )
-
-    def send(
-        self,
-        nei: str,
-        msg: Message,
-        create_connection: bool = False,
-        raise_error: bool = False,
-    ) -> None:
-        if not self._neighbors.exists(nei) and not create_connection:
-            if raise_error:
-                raise NeighborNotConnectedError(f"{nei} is not a neighbor")
-            logger.debug(self._addr, f"Not sending to non-neighbor {nei}")
-            return
-        target = _lookup(nei)
-        if target is None:
-            # Dead peer: evict like the reference's on-send-error removal
-            # (grpc_client.py:176-183).
-            self._neighbors.remove(nei)
-            if raise_error:
-                raise NeighborNotConnectedError(f"{nei} is unreachable")
-            logger.debug(self._addr, f"Send to {nei} failed (unreachable)")
-            return
-        target._receive(msg)
-
-    def broadcast(self, msg: Message, node_list: Optional[list[str]] = None) -> None:
-        targets = node_list or list(self._neighbors.get_all(only_direct=True))
-        for nei in targets:
-            self.send(nei, msg)
-
-    def get_neighbors(self, only_direct: bool = False) -> dict[str, Any]:
-        return dict(self._neighbors.get_all(only_direct))
-
-    def gossip_weights(
-        self,
-        early_stopping_fn,
-        get_candidates_fn,
-        status_fn,
-        model_fn,
-        period: Optional[float] = None,
-        create_connection: bool = False,
-    ) -> None:
-        self._gossiper.gossip_weights(
-            early_stopping_fn,
-            get_candidates_fn,
-            status_fn,
-            model_fn,
-            period=period,
-            send_fn=lambda nei, msg: self.send(
-                nei, msg, create_connection=create_connection
-            ),
-        )
-
-    # --- internals ---
-
-    def _make_connection(self, addr: str) -> Any:
-        """connect_fn for Neighbors: 'dial' the peer through the registry
-        and handshake so it adds us back (reference
-        grpc_neighbors.py:58-120)."""
+    def _dial(self, addr: str) -> Any:
         target = _lookup(addr)
         if target is None:
             raise CommunicationError(f"{addr} is not reachable")
-        target._handshake(self._addr)
         return target
 
-    def _handshake(self, addr: str) -> None:
-        """Peer connected to us: add it as a direct neighbor WITHOUT
-        handshaking back (reference grpc_server.py:135-160)."""
-        target = _lookup(addr)
-        self._neighbors.add(addr, non_direct=False, conn=target)
+    def _handshake(self, addr: str, conn: Any) -> None:
+        # Peer adds us as a direct neighbor with a back-reference
+        # (reference grpc_server.py:135-160 equivalent).
+        conn._neighbors.add(self._addr, non_direct=False, conn=self)
 
-    def _send_disconnect(self, addr: str, conn: Any) -> None:
-        target = _lookup(addr)
-        if target is not None:
-            target._receive(
-                Message(source=self._addr, cmd="_disconnect").new_hash()
-            )
-
-    def _disconnect_handler(self, source: str, **kwargs: Any) -> None:
-        self._neighbors.remove(source, disconnect_msg=False)
-
-    def _heartbeat_handler(self, source: str, args: list[str], **kwargs: Any) -> None:
-        self._heartbeater.beat(source, float(args[0]))
-
-    def _gossip_send(self, nei: str, msg: Message) -> None:
-        self.send(nei, msg)
-
-    def _receive(self, msg: Message) -> None:
-        """Server receive path (reference grpc_server.py:161-215 /
-        memory_server.py:137-204): dedup, dispatch, TTL re-flood."""
-        if not self._started:
-            return
-        if not msg.is_weights:
-            if not self._gossiper.check_and_set_processed(msg.msg_hash):
-                return
-        handler = self._commands.get(msg.cmd)
-        if handler is None:
-            logger.error(self._addr, f"Unknown command {msg.cmd!r} from {msg.source}")
-            return
-        try:
-            if msg.is_weights:
-                handler(
-                    source=msg.source,
-                    round=msg.round,
-                    weights=msg.payload,
-                    contributors=msg.contributors,
-                    num_samples=msg.num_samples,
-                )
-            else:
-                handler(source=msg.source, round=msg.round, args=msg.args)
-        except Exception as e:
-            logger.error(
-                self._addr, f"Command {msg.cmd} from {msg.source} failed: {e}"
-            )
-        # TTL flood (reference grpc_server.py:211-215).
-        if not msg.is_weights and msg.ttl > 1:
-            self._gossiper.add_message(
-                Message(
-                    source=msg.source,
-                    cmd=msg.cmd,
-                    round=msg.round,
-                    args=msg.args,
-                    ttl=msg.ttl - 1,
-                    msg_hash=msg.msg_hash,
-                )
-            )
+    def _transport_send(self, addr: str, conn: Any, msg: Message) -> None:
+        target = conn if conn is not None else _lookup(addr)
+        if target is None or not target._started:
+            raise CommunicationError(f"{addr} is unreachable")
+        target.handle_message(msg)

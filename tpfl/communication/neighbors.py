@@ -29,10 +29,12 @@ class Neighbors:
         self_addr: str,
         connect_fn: Optional[Callable[[str], Any]] = None,
         disconnect_fn: Optional[Callable[[str, Any], None]] = None,
+        close_fn: Optional[Callable[[Any], None]] = None,
     ) -> None:
         self.self_addr = self_addr
         self._connect_fn = connect_fn
         self._disconnect_fn = disconnect_fn
+        self._close_fn = close_fn
         self._neighbors: dict[str, Neighbor] = {}
         self._lock = threading.Lock()
 
@@ -64,14 +66,18 @@ class Neighbors:
     def remove(self, addr: str, disconnect_msg: bool = False) -> None:
         with self._lock:
             nei = self._neighbors.pop(addr, None)
-        if (
-            disconnect_msg
-            and nei is not None
-            and nei.direct
-            and self._disconnect_fn is not None
-        ):
+        if nei is None:
+            return
+        if disconnect_msg and nei.direct and self._disconnect_fn is not None:
             try:
                 self._disconnect_fn(addr, nei.conn)
+            except Exception:
+                pass
+        # Always release the transport handle: a lingering channel keeps
+        # pinging a (possibly stopped) peer server.
+        if nei.conn is not None and self._close_fn is not None:
+            try:
+                self._close_fn(nei.conn)
             except Exception:
                 pass
 
