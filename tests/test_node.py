@@ -100,6 +100,39 @@ def test_star_topology_converges():
             nd.stop()
 
 
+def test_convergence_over_grpc():
+    """E2E convergence over the real-network transport (reference
+    ``test/node_test.py`` runs all convergence tests over loopback gRPC)."""
+    from tpfl.communication.grpc_transport import GrpcCommunicationProtocol
+
+    n, rounds = 2, 1
+    ds = synthetic_mnist(n_train=200 * n, n_test=40 * n, seed=0, noise=0.4)
+    parts = ds.generate_partitions(n, RandomIIDPartitionStrategy, seed=1)
+    nodes = [
+        Node(
+            create_model("mlp", (28, 28), seed=7, hidden_sizes=(32,)),
+            parts[i],
+            protocol=GrpcCommunicationProtocol,
+            learning_rate=0.1,
+            batch_size=32,
+        )
+        for i in range(n)
+    ]
+    for nd in nodes:
+        nd.start()
+    try:
+        nodes[0].connect(nodes[1].addr)
+        wait_convergence(nodes, n - 1, only_direct=False, wait=10)
+        nodes[0].set_start_learning(rounds=rounds, epochs=1)
+        wait_to_finish(nodes, timeout=120)
+        for nd in nodes:
+            assert_stage_history(nd, rounds, None)
+        check_equal_models(nodes)
+    finally:
+        for nd in nodes:
+            nd.stop()
+
+
 def test_interrupt_learning():
     nodes = build_nodes(2)
     try:

@@ -166,14 +166,24 @@ class ThreadedCommunicationProtocol(CommunicationProtocol):
         ephemeral = False
         if entry is not None and conn is None and entry.direct:
             # Direct neighbor learned via server-side handshake (no
-            # back-channel yet): dial lazily and cache.
+            # back-channel yet): dial lazily and cache. The per-entry
+            # lock avoids duplicate concurrent dials (gossiper +
+            # heartbeater); install_conn arbitrates under the table
+            # lock so a racing donation/removal can't leak a channel.
             try:
-                conn = self._dial(nei)
-                entry.conn = conn
+                with entry.dial_lock:
+                    conn = self._neighbors.get_conn(nei)
+                    if conn is None:
+                        conn = self._neighbors.install_conn(nei, self._dial(nei))
             except Exception as e:
                 if raise_error:
                     raise NeighborNotConnectedError(f"{nei} unreachable: {e}")
                 logger.debug(self._addr, f"Dial {nei} failed: {e}")
+                return
+            if conn is None:
+                # Peer was removed while we dialed; the channel is closed.
+                if raise_error:
+                    raise NeighborNotConnectedError(f"{nei} was removed")
                 return
         if entry is None or (conn is None and not entry.direct):
             if not create_connection:

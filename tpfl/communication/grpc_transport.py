@@ -140,7 +140,11 @@ class GrpcCommunicationProtocol(ThreadedCommunicationProtocol):
 
     def _rpc_handshake(self, request: bytes, context: Any) -> bytes:
         peer = msgpack.unpackb(request, raw=False)["addr"]
-        self._neighbors.add(peer, non_direct=False, conn=None)
+        # Register the caller WITHOUT dialing back: a reverse handshake
+        # here would recurse (each handshake triggering another) until
+        # both executors deadlock. The send path dials lazily
+        # (base.py lazy-dial for direct peers with no back-channel).
+        self._neighbors.add(peer, non_direct=False, dial=False)
         return msgpack.packb({"ok": True})
 
     def _rpc_disconnect(self, request: bytes, context: Any) -> bytes:

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import threading
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
 
@@ -19,6 +19,9 @@ class Neighbor:
     conn: Any  # transport-specific handle (None for non-direct peers)
     direct: bool
     last_beat: float
+    # Serializes lazy back-channel dials (base.py send path) so
+    # concurrent senders don't each open-and-leak a connection.
+    dial_lock: threading.Lock = field(default_factory=threading.Lock)
 
 
 class Neighbors:
@@ -38,9 +41,21 @@ class Neighbors:
         self._neighbors: dict[str, Neighbor] = {}
         self._lock = threading.Lock()
 
-    def add(self, addr: str, non_direct: bool = False, conn: Any = None) -> bool:
+    def add(
+        self,
+        addr: str,
+        non_direct: bool = False,
+        conn: Any = None,
+        dial: bool = True,
+    ) -> bool:
         """Add a peer; direct adds may build a transport connection via
-        the protocol's connect_fn. Returns success."""
+        the protocol's connect_fn. Returns success.
+
+        ``dial=False`` registers a direct peer *without* dialing back —
+        the server-side handshake path (reference
+        ``grpc_server.py:135-160`` adds the caller without a reverse
+        handshake; the send path dials lazily when first needed).
+        """
         if addr == self.self_addr:
             return False
         with self._lock:
@@ -50,17 +65,33 @@ class Neighbors:
                 if existing.direct or non_direct:
                     existing.last_beat = time.time()
                     return True
-        if not non_direct and self._connect_fn is not None and conn is None:
+        if not non_direct and dial and self._connect_fn is not None and conn is None:
             try:
                 conn = self._connect_fn(addr)
             except Exception:
                 return False
             if conn is None:
                 return False
+        leaked = None
         with self._lock:
-            self._neighbors[addr] = Neighbor(
-                conn=conn, direct=not non_direct, last_beat=time.time()
-            )
+            # Re-check: a concurrent add (e.g. the peer's handshake RPC
+            # racing our connect) may have inserted while we dialed.
+            existing = self._neighbors.get(addr)
+            if existing is not None and (existing.direct or non_direct):
+                existing.last_beat = time.time()
+                if not non_direct and existing.conn is None and conn is not None:
+                    existing.conn = conn  # donate our fresh connection
+                else:
+                    leaked = conn  # theirs wins; release ours below
+            else:
+                self._neighbors[addr] = Neighbor(
+                    conn=conn, direct=not non_direct, last_beat=time.time()
+                )
+        if leaked is not None and self._close_fn is not None:
+            try:
+                self._close_fn(leaked)
+            except Exception:
+                pass
         return True
 
     def remove(self, addr: str, disconnect_msg: bool = False) -> None:
@@ -93,6 +124,35 @@ class Neighbors:
                 nei.last_beat = t
                 return
         self.add(addr, non_direct=True)
+
+    def install_conn(self, addr: str, conn: Any) -> Any:
+        """Install a back-channel for a direct peer under the table
+        lock. Returns the entry's resulting conn — ``conn`` if it won,
+        the already-present one if another thread (or the handshake
+        donation path) got there first — or None if the peer has been
+        removed meanwhile. Losing/orphaned connections are closed here,
+        so callers cannot leak what they dialed."""
+        close = None
+        with self._lock:
+            nei = self._neighbors.get(addr)
+            if nei is None or not nei.direct:
+                close, result = conn, None
+            elif nei.conn is None:
+                nei.conn = conn
+                result = conn
+            else:
+                close, result = conn, nei.conn
+        if close is not None and self._close_fn is not None:
+            try:
+                self._close_fn(close)
+            except Exception:
+                pass
+        return result
+
+    def get_conn(self, addr: str) -> Any:
+        with self._lock:
+            nei = self._neighbors.get(addr)
+            return nei.conn if nei is not None else None
 
     def get(self, addr: str) -> Optional[Neighbor]:
         with self._lock:
