@@ -1,0 +1,194 @@
+"""Simulation layer tests — pool batching, virtual learner delegation,
+batched-vs-inline equivalence (reference test model:
+``test/simulation/actor_pool_test.py``, ``virtual_node_learner_test.py``)."""
+
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+import tpfl.simulation.pool as pool_mod
+from tpfl.learning.dataset import RandomIIDPartitionStrategy, synthetic_mnist
+from tpfl.learning.jax_learner import JaxLearner
+from tpfl.models import create_model
+from tpfl.settings import Settings
+from tpfl.simulation import (
+    SuperLearnerPool,
+    VirtualNodeLearner,
+    try_init_learner_with_simulation,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_pool():
+    SuperLearnerPool.reset()
+    yield
+    SuperLearnerPool.reset()
+
+
+def make_learner(addr, n=128, seed=0, hidden=(16,)):
+    ds = synthetic_mnist(n_train=n, n_test=32, seed=seed)
+    model = create_model("mlp", (28, 28), seed=3, hidden_sizes=hidden)
+    return JaxLearner(
+        model=model, data=ds, addr=addr, learning_rate=0.1, batch_size=32
+    )
+
+
+def test_singleton_semantics():
+    a = SuperLearnerPool.instance()
+    b = SuperLearnerPool.instance()
+    assert a is b
+    SuperLearnerPool.reset()
+    assert SuperLearnerPool.instance() is not a
+
+
+def test_activation_hook():
+    ln = make_learner("hook-node")
+    wrapped = try_init_learner_with_simulation(ln)
+    assert isinstance(wrapped, VirtualNodeLearner)
+    # Idempotent
+    assert try_init_learner_with_simulation(wrapped) is wrapped
+    # Disabled -> untouched
+    Settings.DISABLE_SIMULATION = True
+    try:
+        assert try_init_learner_with_simulation(ln) is ln
+    finally:
+        Settings.DISABLE_SIMULATION = False
+
+
+def test_virtual_learner_delegates():
+    ln = make_learner("deleg-node")
+    v = VirtualNodeLearner(ln)
+    assert v.get_addr() == "deleg-node"
+    assert v.get_model() is ln.get_model()
+    v.set_epochs(3)
+    assert ln.epochs == 3 and v.epochs == 3
+    assert v.get_num_samples() == ln.get_num_samples()
+    assert v.get_framework() == "jax"
+    m = v.evaluate()
+    assert "test_metric" in m
+
+
+def test_concurrent_fits_batch_into_one_program(monkeypatch):
+    """4 concurrent fits with one signature -> one batched call."""
+    calls = []
+    real = pool_mod.run_batched_fits
+
+    def spy(sig, learners):
+        calls.append(len(learners))
+        return real(sig, learners)
+
+    monkeypatch.setattr(pool_mod, "run_batched_fits", spy)
+
+    learners = [make_learner(f"bn-{i}", seed=i) for i in range(4)]
+    before = [
+        jax.tree_util.tree_map(np.asarray, ln.get_model().get_parameters())
+        for ln in learners
+    ]
+    wrapped = [VirtualNodeLearner(ln) for ln in learners]
+    threads = [threading.Thread(target=w.fit) for w in wrapped]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert calls == [4]
+    for ln, b4 in zip(learners, before):
+        after = ln.get_model().get_parameters()
+        changed = jax.tree_util.tree_map(
+            lambda a, b: not np.allclose(a, b), after, b4
+        )
+        assert any(jax.tree_util.tree_leaves(changed))
+        assert ln.get_model().get_num_samples() == 128
+        assert ln.get_model().get_contributors() == [ln.get_addr()]
+
+
+def test_batched_matches_inline_exactly():
+    """Same node trained batched (group of 2 clones) vs inline gives
+    bit-comparable parameters — the batched program IS JaxLearner.fit."""
+    # Two clones of the same node (same addr => same shuffle seed).
+    a = make_learner("twin", n=96, seed=5)
+    b = make_learner("twin", n=96, seed=5)
+    inline = make_learner("twin", n=96, seed=5)
+    for ln in (a, b, inline):
+        ln.set_epochs(1)
+
+    inline_model = inline.fit()
+
+    wrapped = [VirtualNodeLearner(a), VirtualNodeLearner(b)]
+    threads = [threading.Thread(target=w.fit) for w in wrapped]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+
+    for ln in (a, b):
+        got = jax.tree_util.tree_leaves(ln.get_model().get_parameters())
+        want = jax.tree_util.tree_leaves(inline_model.get_parameters())
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(w), rtol=2e-5, atol=2e-6
+            )
+
+
+def test_unequal_partition_sizes_batch_with_padding():
+    """Nodes with different batch counts batch together; padded batches
+    are no-ops (masked), so each node trains on exactly its own data."""
+    big = make_learner("pad-big", n=160, seed=1)
+    small = make_learner("pad-small", n=64, seed=2)
+    solo = make_learner("pad-small", n=64, seed=2)  # clone of small
+    solo_model = solo.fit()
+
+    wrapped = [VirtualNodeLearner(big), VirtualNodeLearner(small)]
+    threads = [threading.Thread(target=w.fit) for w in wrapped]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+
+    # small trained in the padded batch == small trained alone
+    got = jax.tree_util.tree_leaves(small.get_model().get_parameters())
+    want = jax.tree_util.tree_leaves(solo_model.get_parameters())
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(w), rtol=2e-5, atol=2e-6
+        )
+    assert small.get_model().get_num_samples() == 64
+    assert big.get_model().get_num_samples() == 160
+
+
+def test_heterogeneous_jobs_fall_back():
+    """Different architectures can't batch; both still train."""
+    a = make_learner("het-a", hidden=(16,))
+    b = make_learner("het-b", hidden=(24,))
+    wrapped = [VirtualNodeLearner(a), VirtualNodeLearner(b)]
+    threads = [threading.Thread(target=w.fit) for w in wrapped]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    for ln in (a, b):
+        assert ln.get_model().get_num_samples() == 128
+
+
+def test_chunking_respects_max_batch_nodes(monkeypatch):
+    import tpfl.simulation.batched_fit as bf
+
+    chunks = []
+    real = bf._run_chunk
+
+    def spy(prog, learners):
+        chunks.append(len(learners))
+        return real(prog, learners)
+
+    monkeypatch.setattr(bf, "_run_chunk", spy)
+    Settings.SIM_MAX_BATCH_NODES = 3
+
+    learners = [make_learner(f"ch-{i}", seed=i) for i in range(5)]
+    wrapped = [VirtualNodeLearner(ln) for ln in learners]
+    threads = [threading.Thread(target=w.fit) for w in wrapped]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+    assert sorted(chunks) == [2, 3]

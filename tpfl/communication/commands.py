@@ -187,6 +187,28 @@ class PartialModelCommand(NodeCommand):
         st = self.state
         if st.round is None:
             return
+        if round == st.round + 1:
+            # Fast peer already in the next round: hold the model until
+            # our TrainStage opens that round (drained there), instead
+            # of dropping it and stalling the late trainer for the full
+            # aggregation timeout.
+            st.stash_pending_partial(
+                (source, round, weights, contributors, num_samples), round
+            )
+            # Close the stash/drain race: if our round advanced (and its
+            # aggregation opened) while we were stashing, TrainStage's
+            # drain may have already run — replay now. drain is
+            # pop-once, so a concurrent drain can't double-deliver.
+            if st.round == round and self.node.aggregator.is_open():
+                for args in st.drain_pending_partials(round):
+                    self.execute(
+                        args[0],
+                        args[1],
+                        weights=args[2],
+                        contributors=args[3],
+                        num_samples=args[4],
+                    )
+            return
         if round != st.round:
             logger.debug(
                 st.addr,

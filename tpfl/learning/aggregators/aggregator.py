@@ -83,6 +83,11 @@ class Aggregator(ABC):
             # set in add_model and be dropped at round start.
             self._finish_aggregation_event.clear()
 
+    def is_open(self) -> bool:
+        """True while a round's aggregation is in progress (between
+        set_nodes_to_aggregate and full coverage / clear)."""
+        return not self._finish_aggregation_event.is_set()
+
     def clear(self) -> None:
         """End a round (reference RoundFinishedStage calls this)."""
         with self._lock:

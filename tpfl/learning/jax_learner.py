@@ -52,6 +52,79 @@ def _addr_seed(addr: str) -> int:
     return zlib.crc32(addr.encode())
 
 
+_SHARED_PROGRAMS: dict[tuple, Callable] = {}
+"""Compiled train/eval programs shared across ALL learners in the
+process, keyed by (kind, module config, loss, ...). Without this, N
+simulated nodes with identical architectures each build their own jit
+closure and XLA compiles the same program N times — at 100+ nodes the
+compile serialization dominates the whole experiment."""
+
+
+def _shared_program(key: tuple, build: Callable[[], Callable]) -> Callable:
+    fn = _SHARED_PROGRAMS.get(key)
+    if fn is None:
+        fn = _SHARED_PROGRAMS[key] = build()
+    return fn
+
+
+def make_train_step(
+    module: Any, loss_fn: Callable, has_aux: bool
+) -> Callable:
+    """THE local SGD step: forward, per-batch loss, grads + callback
+    correction, optimizer update, mutable-collection (aux) threading.
+    Single definition shared by the inline epoch (JaxLearner) and the
+    vmapped batched path (tpfl.simulation.batched_fit) so the two can
+    never drift numerically.
+
+    Returns ``step(state, x, y, correction) -> (state, (loss, acc))``.
+    """
+
+    def apply(params, aux, x, train):
+        variables = {"params": params, **(aux or {})}
+        if has_aux:
+            logits, updates = module.apply(
+                variables, x, train=train, mutable=list(aux.keys())
+            )
+            return logits, updates
+        return module.apply(variables, x, train=train), aux
+
+    def step(state: TrainState, x, y, correction):
+        def loss_of(params):
+            logits, new_aux = apply(params, state.aux_state, x, True)
+            return loss_fn(logits, y).mean(), (logits, new_aux)
+
+        (loss, (logits, new_aux)), grads = jax.value_and_grad(
+            loss_of, has_aux=True
+        )(state.params)
+        grads = jax.tree_util.tree_map(
+            lambda g, c: g + c.astype(g.dtype), grads, correction
+        )
+        state = state.apply_gradients(grads=grads)
+        state = state.replace(aux_state=new_aux)
+        acc = jnp.mean(jnp.argmax(logits, -1) == y)
+        return state, (loss, acc)
+
+    return step
+
+
+_TX_CACHE: dict[tuple, optax.GradientTransformation] = {}
+
+
+def shared_tx(
+    factory: Callable[[float], optax.GradientTransformation], lr: float
+) -> optax.GradientTransformation:
+    """One optimizer instance per (factory, lr). ``tx`` is a STATIC
+    field of TrainState (part of every jit cache key, compared by the
+    identity of its update/init functions) — a fresh ``optax.sgd(...)``
+    per learner or per round would silently recompile the train epoch
+    every time."""
+    key = (factory, float(lr))
+    tx = _TX_CACHE.get(key)
+    if tx is None:
+        tx = _TX_CACHE[key] = factory(lr)
+    return tx
+
+
 def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
     """Per-sample loss vector [batch]; training takes the mean, masked
     eval weights each sample — one definition serves both. Canonical
@@ -96,6 +169,7 @@ class JaxLearner(Learner):
         super().__init__(model, data, addr, aggregator)
         self.learning_rate = float(learning_rate)
         self._optimizer_factory = optimizer_factory or default_optimizer
+        self._tx = shared_tx(self._optimizer_factory, self.learning_rate)
         self.batch_size = int(batch_size)
         self._loss_fn = loss_fn
         self._interrupt = threading.Event()
@@ -127,38 +201,17 @@ class JaxLearner(Learner):
         module = self._module()
         loss_fn = self._loss_fn
         has_aux = self._has_aux()
+        key = ("train_epoch", repr(module), loss_fn, has_aux)
+        return _shared_program(key, lambda: self._make_train_epoch(module, loss_fn, has_aux))
 
-        def apply(params, aux, x, train):
-            variables = {"params": params, **(aux or {})}
-            if has_aux:
-                logits, updates = module.apply(
-                    variables, x, train=train, mutable=list(aux.keys())
-                )
-                return logits, updates
-            return module.apply(variables, x, train=train), aux
-
-        def step(state: TrainState, batch, correction):
-            x, y = batch
-
-            def loss_of(params):
-                logits, new_aux = apply(params, state.aux_state, x, True)
-                return loss_fn(logits, y).mean(), (logits, new_aux)
-
-            (loss, (logits, new_aux)), grads = jax.value_and_grad(
-                loss_of, has_aux=True
-            )(state.params)
-            grads = jax.tree_util.tree_map(
-                lambda g, c: g + c.astype(g.dtype), grads, correction
-            )
-            state = state.apply_gradients(grads=grads)
-            state = state.replace(aux_state=new_aux)
-            acc = jnp.mean(jnp.argmax(logits, -1) == y)
-            return state, (loss, acc)
+    @staticmethod
+    def _make_train_epoch(module: Any, loss_fn: Callable, has_aux: bool) -> Callable:
+        step = make_train_step(module, loss_fn, has_aux)
 
         @partial(jax.jit, donate_argnums=(0,))
         def train_epoch(state: TrainState, xs, ys, correction):
             state, (losses, accs) = jax.lax.scan(
-                lambda s, b: step(s, b, correction), state, (xs, ys)
+                lambda s, b: step(s, b[0], b[1], correction), state, (xs, ys)
             )
             return state, jnp.mean(losses), jnp.mean(accs)
 
@@ -170,6 +223,11 @@ class JaxLearner(Learner):
         so one compiled shape covers any test-set size."""
         module = self._module()
         loss_fn = self._loss_fn
+        key = ("eval", repr(module), loss_fn, n_classes)
+        return _shared_program(key, lambda: self._make_eval(module, loss_fn, n_classes))
+
+    @staticmethod
+    def _make_eval(module: Any, loss_fn: Callable, n_classes: int) -> Callable:
 
         @jax.jit
         def eval_batches(params, aux, xs, ys, ms):
@@ -203,28 +261,15 @@ class JaxLearner(Learner):
 
     # --- Learner API ---
 
-    def fit(self) -> TpflModel:
-        """Run ``self.epochs`` local epochs; one XLA program per epoch."""
-        self._interrupt.clear()
+    def prepare_fit(self) -> tuple[TpflModel, Any, Any, Any]:
+        """Host-side pre-fit lifecycle: callbacks see round-start params
+        and may contribute a gradient correction (zeros otherwise).
+        Shared verbatim by the batched simulation path
+        (tpfl.simulation.batched_fit) so the two never drift.
+
+        Returns (model, initial_params, correction, batches)."""
         model = self.get_model()
-        if self._train_epoch_fn is None:
-            self._train_epoch_fn = self._build_train_epoch()
-
-        base_seed = (Settings.SEED or 0) + _addr_seed(self._addr)
-        # Train on a copy: the state is donated to the compiled epoch,
-        # which invalidates its buffers on TPU — the model's own params
-        # must stay readable (gossip threads serve them mid-fit), and
-        # callbacks need the round-start values after training.
-        state = TrainState.create(
-            apply_fn=self._module().apply,
-            params=jax.tree_util.tree_map(jnp.copy, model.get_parameters()),
-            tx=self._optimizer_factory(self.learning_rate),
-            aux_state=jax.tree_util.tree_map(jnp.copy, model.aux_state or {}),
-        )
         initial_params = model.get_parameters()
-
-        # Callbacks see round-start params; correction is zeros unless a
-        # callback (SCAFFOLD) provides one.
         for cb in self.callbacks:
             cb.on_fit_start(initial_params, self.learning_rate)
         correction = None
@@ -240,8 +285,59 @@ class JaxLearner(Learner):
             correction = jax.tree_util.tree_map(
                 lambda p: jnp.zeros((), p.dtype), initial_params
             )
+        batches = self._train_data((Settings.SEED or 0) + _addr_seed(self._addr))
+        return model, initial_params, correction, batches
 
-        batches = self._train_data(base_seed)
+    def finish_fit(
+        self,
+        model: TpflModel,
+        initial_params: Any,
+        final_params: Any,
+        final_aux: Any,
+        n_steps: int,
+        num_samples: int,
+    ) -> None:
+        """Host-side post-fit lifecycle (counterpart of prepare_fit)."""
+        model.set_parameters(final_params)
+        if final_aux:
+            model.aux_state = final_aux
+        model.set_contribution([self._addr], num_samples)
+        for cb in self.callbacks:
+            cb.on_fit_end(
+                initial_params, final_params, n_steps, self.learning_rate
+            )
+        self.add_callback_info_to_model()
+
+    def skip_fit(self) -> TpflModel:
+        """Interrupted (or epochs=0) before any step: model unchanged,
+        zero FL weight, and no fabricated callback deltas — a node that
+        did no training must not move the global control variates or
+        count in the weighted mean."""
+        model = self.get_model()
+        model.set_contribution([self._addr], 0)
+        return model
+
+    def fit(self) -> TpflModel:
+        """Run ``self.epochs`` local epochs; one XLA program per epoch."""
+        self._interrupt.clear()
+        if self._train_epoch_fn is None:
+            self._train_epoch_fn = self._build_train_epoch()
+
+        model, initial_params, correction, batches = self.prepare_fit()
+        # Train on a copy: the state is donated to the compiled epoch,
+        # which invalidates its buffers on TPU — the model's own params
+        # must stay readable (gossip threads serve them mid-fit), and
+        # callbacks need the round-start values after training.
+        # apply_fn=None and the shared tx keep the TrainState's STATIC
+        # fields identical across learners and rounds — otherwise every
+        # fit() (new bound method / new optax instance) would be a jit
+        # cache miss and recompile the epoch program.
+        state = TrainState.create(
+            apply_fn=None,
+            params=jax.tree_util.tree_map(jnp.copy, initial_params),
+            tx=self._tx,
+            aux_state=jax.tree_util.tree_map(jnp.copy, model.aux_state or {}),
+        )
         in_exp = self._in_experiment()
         n_steps = 0
         for epoch in range(self.epochs):
@@ -264,22 +360,16 @@ class JaxLearner(Learner):
         self._round_counter += 1
 
         if n_steps == 0:
-            # Interrupted (or epochs=0) before any step: model unchanged,
-            # zero FL weight, and no fabricated callback deltas — a node
-            # that did no training must not move the global control
-            # variates or count in the weighted mean.
-            model.set_contribution([self._addr], 0)
-            return model
+            return self.skip_fit()
 
-        model.set_parameters(state.params)
-        if state.aux_state:
-            model.aux_state = state.aux_state
-        model.set_contribution([self._addr], batches.num_samples)
-        for cb in self.callbacks:
-            cb.on_fit_end(
-                initial_params, state.params, n_steps, self.learning_rate
-            )
-        self.add_callback_info_to_model()
+        self.finish_fit(
+            model,
+            initial_params,
+            state.params,
+            state.aux_state,
+            n_steps,
+            batches.num_samples,
+        )
         return model
 
     def _in_experiment(self) -> bool:
@@ -288,6 +378,13 @@ class JaxLearner(Learner):
 
     def interrupt_fit(self) -> None:
         self._interrupt.set()
+
+    def reset_interrupt(self) -> None:
+        """Clear a stale interrupt. fit() does this on entry; the
+        simulation pool does it at submission so an interrupt from a
+        PREVIOUS experiment can't skip the next round's batched fit
+        (interrupts arriving after submission are still honored)."""
+        self._interrupt.clear()
 
     def evaluate(self) -> dict[str, float]:
         """Loss + accuracy + macro precision/recall/F1 from one jitted

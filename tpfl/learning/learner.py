@@ -75,6 +75,13 @@ class Learner(ABC):
     def set_epochs(self, epochs: int) -> None:
         self.epochs = int(epochs)
 
+    def set_fit_group_hint(self, peers: "int | list[str]") -> None:
+        """Hint which peers (the round's train set, as addresses) — or
+        how many — will call ``fit`` around the same time. Default:
+        ignored; the simulation pool uses it to batch the whole group
+        into one XLA program, waiting only for the members that live in
+        THIS process."""
+
     # --- callback info transport (reference learner.py:122-135) ---
 
     def update_callbacks_with_model_info(self) -> None:

@@ -86,6 +86,14 @@ class Node:
                 **learner_kwargs,
             )
 
+        # Simulation activation hook (reference node wiring via
+        # try_init_learner_with_ray, simulation/__init__.py:16-33):
+        # concurrent fits across in-process nodes batch into one
+        # vmapped XLA program unless Settings.DISABLE_SIMULATION.
+        from tpfl.simulation import try_init_learner_with_simulation
+
+        self.learner = try_init_learner_with_simulation(self.learner)
+
         # Experiment parameters (set by set_start_learning / command)
         self.rounds: int = 0
         self.epochs: int = 1

@@ -45,6 +45,14 @@ class NodeState:
         self.models_aggregated_lock = threading.Lock()
         self.nei_status: dict[str, int] = {}  # addr -> last finished round (-1 = model initialized)
 
+        # Next-round partial models. At scale, a fast peer's round-r+1
+        # PartialModel can arrive while this node is still closing round
+        # r; dropping it (reference partial_model_command.py:72-82) makes
+        # the late trainer block the whole AGGREGATION_TIMEOUT. Stash and
+        # replay when the round's TrainStage opens.
+        self.pending_partials: list[tuple] = []
+        self.pending_partials_lock = threading.Lock()
+
     # --- experiment delegation (reference node_state.py:84-97) ---
 
     @property
@@ -69,6 +77,26 @@ class NodeState:
         self.experiment.increase_round()
         with self.models_aggregated_lock:
             self.models_aggregated = {}
+
+    def stash_pending_partial(self, args: tuple, for_round: int) -> None:
+        """Hold a next-round PartialModel until that round opens; stale
+        entries (older rounds) are pruned in passing."""
+        with self.pending_partials_lock:
+            cur = self.round
+            self.pending_partials = [
+                (r, a)
+                for r, a in self.pending_partials
+                if cur is None or r >= cur
+            ][-64:]
+            self.pending_partials.append((for_round, args))
+
+    def drain_pending_partials(self, for_round: int) -> list[tuple]:
+        with self.pending_partials_lock:
+            take = [a for r, a in self.pending_partials if r == for_round]
+            self.pending_partials = [
+                (r, a) for r, a in self.pending_partials if r != for_round
+            ]
+        return take
 
     def set_models_aggregated(self, node: str, models: list[str]) -> None:
         with self.models_aggregated_lock:

@@ -1,0 +1,31 @@
+"""Scale-out simulation layer.
+
+The reference multiplexes N logical FL nodes over K Ray actor processes
+(``simulation/actor_pool.py:69``, ``virtual_learner.py:31``, activation
+hook ``simulation/__init__.py:16-33``) — each ``fit()`` ships the whole
+learner through the Ray object store to a worker.
+
+The TPU-native replacement keeps every learner in-process and instead
+**batches concurrent ``fit()`` calls into one vmapped XLA program**: when
+several protocol nodes (the round's train set) hit ``fit()`` within the
+batching window, their parameters/corrections/data are stacked on a
+leading ``nodes`` axis and trained by a single compiled program — N
+local trainings for the price of one XLA dispatch (chunked to bound
+memory). Heterogeneous or non-JAX jobs fall back to a thread pool.
+
+Activation mirrors the reference hook: :func:`try_init_learner_with_simulation`
+wraps a learner in :class:`VirtualNodeLearner` unless
+``Settings.DISABLE_SIMULATION``.
+"""
+
+from tpfl.simulation.pool import SuperLearnerPool
+from tpfl.simulation.virtual_learner import (
+    VirtualNodeLearner,
+    try_init_learner_with_simulation,
+)
+
+__all__ = [
+    "SuperLearnerPool",
+    "VirtualNodeLearner",
+    "try_init_learner_with_simulation",
+]

@@ -1,0 +1,201 @@
+"""SuperLearnerPool — the shared fit-batching executor.
+
+Reference seam: ``SuperActorPool`` (``simulation/actor_pool.py:69-99``),
+a singleton Ray actor pool all ``VirtualNodeLearner``s submit to. Here
+the pool is a dispatcher thread that collects concurrent fit
+submissions for a short window (``Settings.SIM_BATCH_WINDOW``), groups
+them by homogeneity signature, and runs each group as ONE vmapped XLA
+program (``batched_fit``). Jobs that cannot batch (unique signature,
+non-JaxLearner, or a batched-path failure) run on a thread pool of
+``Settings.SIM_WORKERS`` threads instead — the reference's K-worker
+multiplexing without the object-store round-trips.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Optional
+
+from tpfl.learning.jax_learner import JaxLearner
+from tpfl.learning.learner import Learner
+from tpfl.learning.model import TpflModel
+from tpfl.management.logger import logger
+from tpfl.settings import Settings
+from tpfl.simulation.batched_fit import job_signature, run_batched_fits
+
+
+class _FitJob:
+    __slots__ = ("learner", "done", "error", "group_hint")
+
+    def __init__(self, learner: Learner, group_hint: int = 0) -> None:
+        self.learner = learner
+        self.done = threading.Event()
+        self.error: Optional[BaseException] = None
+        self.group_hint = group_hint
+
+
+class SuperLearnerPool:
+    """Process-wide singleton batching executor (reference
+    ``SuperActorPool`` singleton semantics, ``actor_pool.py:77-99``)."""
+
+    _instance: Optional["SuperLearnerPool"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self._queue: list[_FitJob] = []
+        self._queue_lock = threading.Condition()
+        self._dispatcher: Optional[threading.Thread] = None
+        self._stop = False
+        workers = int(Settings.SIM_WORKERS) or (os.cpu_count() or 4)
+        self._fallback = ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix="tpfl-sim"
+        )
+
+    @classmethod
+    def instance(cls) -> "SuperLearnerPool":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = SuperLearnerPool()
+            return cls._instance
+
+    @classmethod
+    def reset(cls) -> None:
+        """Tear down the singleton (tests / reconfiguration)."""
+        with cls._instance_lock:
+            inst, cls._instance = cls._instance, None
+        if inst is not None:
+            with inst._queue_lock:
+                inst._stop = True
+                inst._queue_lock.notify_all()
+            if inst._dispatcher is not None:
+                inst._dispatcher.join(timeout=5)
+            inst._fallback.shutdown(wait=False)
+
+    # --- submission (called from each node's learning thread) ---
+
+    def submit_fit(self, learner: Learner, group_hint: int = 0) -> TpflModel:
+        """Block until the pool has trained this learner; returns its
+        updated model (mirrors ``VirtualNodeLearner.fit`` blocking on the
+        actor result, reference ``virtual_learner.py:101-137``).
+
+        ``group_hint``: expected number of concurrent fits (the round's
+        train-set size) — the dispatcher holds the batch until that many
+        arrive or ``SIM_BATCH_MAX_WAIT`` elapses."""
+        job = _FitJob(learner, group_hint=group_hint)
+        # Submission == fit entry: drop any stale interrupt from a past
+        # experiment (inline fit() clears on entry; the batched path
+        # honors interrupts set after this point).
+        reset = getattr(learner, "reset_interrupt", None)
+        if reset is not None:
+            reset()
+        with self._queue_lock:
+            if self._stop:
+                raise RuntimeError("SuperLearnerPool is shut down")
+            self._queue.append(job)
+            if self._dispatcher is None or not self._dispatcher.is_alive():
+                self._dispatcher = threading.Thread(
+                    target=self._dispatch_loop,
+                    name="tpfl-sim-dispatcher",
+                    daemon=True,
+                )
+                self._dispatcher.start()
+            self._queue_lock.notify_all()
+        job.done.wait()
+        if job.error is not None:
+            raise job.error
+        return learner.get_model()
+
+    # --- dispatcher ---
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            with self._queue_lock:
+                while not self._queue and not self._stop:
+                    self._queue_lock.wait(timeout=1.0)
+                if self._stop:
+                    for j in self._queue:
+                        j.error = RuntimeError("pool shut down")
+                        j.done.set()
+                    self._queue.clear()
+                    return
+            # Batching window: let the rest of the train set arrive.
+            # When submitters hint the group size (train-set size), hold
+            # up to SIM_BATCH_MAX_WAIT until the group is full — capped
+            # by the number of in-process nodes, so a 1-node real-network
+            # process never waits for peers that live elsewhere.
+            from tpfl.simulation.virtual_learner import VirtualNodeLearner
+
+            deadline = time.monotonic() + float(Settings.SIM_BATCH_MAX_WAIT)
+            window_end = time.monotonic() + float(Settings.SIM_BATCH_WINDOW)
+            while True:
+                with self._queue_lock:
+                    jobs = list(self._queue)
+                hints = [j.group_hint for j in jobs if j.group_hint > 0]
+                target = (
+                    min(max(hints), max(VirtualNodeLearner.live_count(), 1))
+                    if hints
+                    else 0
+                )
+                now = time.monotonic()
+                if hints and (len(jobs) >= target or now >= deadline):
+                    break
+                if not hints and now >= window_end:
+                    break
+                time.sleep(0.02)
+            with self._queue_lock:
+                batch, self._queue = self._queue, []
+            try:
+                self._run_batch(batch)
+            except BaseException as e:  # dispatcher must survive anything
+                for j in batch:
+                    if not j.done.is_set():
+                        j.error = e
+                        j.done.set()
+
+    def _run_batch(self, batch: list[_FitJob]) -> None:
+        groups: dict[Any, list[_FitJob]] = {}
+        singles: list[_FitJob] = []
+        for job in batch:
+            if isinstance(job.learner, JaxLearner):
+                try:
+                    groups.setdefault(job_signature(job.learner), []).append(job)
+                    continue
+                except Exception:
+                    pass
+            singles.append(job)
+
+        for sig, jobs in groups.items():
+            if len(jobs) == 1:
+                singles.append(jobs[0])
+                continue
+            try:
+                failed = run_batched_fits(sig, [j.learner for j in jobs])
+            except Exception as e:
+                # Signature-level failure (nothing trained): everyone
+                # falls back. Chunk-level failures are reported via
+                # ``failed`` instead — re-fitting a chunk that already
+                # trained would double its epochs and callback deltas.
+                logger.info(
+                    "simulation",
+                    f"Batched fit of {len(jobs)} nodes failed ({e}); "
+                    "falling back to per-learner fits",
+                )
+                singles.extend(jobs)
+                continue
+            failed_ids = {id(ln) for ln in failed}
+            for j in jobs:
+                if id(j.learner) in failed_ids:
+                    singles.append(j)
+                else:
+                    j.done.set()
+
+        futures = [(j, self._fallback.submit(j.learner.fit)) for j in singles]
+        for j, fut in futures:
+            try:
+                fut.result()
+            except BaseException as e:
+                j.error = e
+            j.done.set()

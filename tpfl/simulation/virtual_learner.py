@@ -1,0 +1,120 @@
+"""VirtualNodeLearner — learner decorator routing fits to the pool.
+
+Parity with reference ``simulation/virtual_learner.py:31-141``: wraps
+any :class:`Learner`, delegates everything, but ``fit()`` goes through
+the shared :class:`SuperLearnerPool` so concurrent fits across protocol
+nodes batch into one vmapped XLA program. Unlike the reference,
+``interrupt_fit`` IS implemented (delegates to the inner learner):
+an interrupt delivered before the batch dispatches skips that node's
+training entirely (zero contribution); once the compiled batched round
+launches it is not interruptible — only the inline fallback can still
+stop between epochs.
+
+Activation hook parity: ``try_init_learner_with_simulation`` mirrors
+``try_init_learner_with_ray`` (``simulation/__init__.py:16-33``) — wraps
+unless ``Settings.DISABLE_SIMULATION``.
+"""
+
+from __future__ import annotations
+
+import weakref
+from typing import Union
+
+from tpfl.learning.dataset.tpfl_dataset import TpflDataset
+from tpfl.learning.learner import Learner
+from tpfl.learning.model import TpflModel
+from tpfl.settings import Settings
+from tpfl.simulation.pool import SuperLearnerPool
+
+_live_learners: "weakref.WeakSet[VirtualNodeLearner]" = weakref.WeakSet()
+
+
+class VirtualNodeLearner(Learner):
+    """Decorator: same Learner surface, pooled execution."""
+
+    def __init__(self, learner: Learner) -> None:
+        # No super().__init__: all state lives in the wrapped learner.
+        self.learner = learner
+        self._group_hint: "int | list[str]" = 0
+        _live_learners.add(self)
+
+    @staticmethod
+    def live_count() -> int:
+        """Upper bound on in-process simulated nodes — caps how long the
+        pool waits for a hinted fit group to fill (a 1-node real-network
+        process must not wait for 7 peers that live elsewhere)."""
+        return len(_live_learners)
+
+    # --- pooled execution ---
+
+    def set_fit_group_hint(self, peers: "int | list[str]") -> None:
+        self._group_hint = peers
+
+    def fit(self) -> TpflModel:
+        hint = self._group_hint
+        if not isinstance(hint, int):
+            # Exact local group size: only the train-set members hosted
+            # in THIS process will submit fits here — waiting for the
+            # remote ones would stall every round by SIM_BATCH_MAX_WAIT.
+            local = {ln.get_addr() for ln in _live_learners}
+            hint = len(set(hint) & local)
+        return SuperLearnerPool.instance().submit_fit(
+            self.learner, group_hint=hint
+        )
+
+    def interrupt_fit(self) -> None:
+        self.learner.interrupt_fit()
+
+    def evaluate(self) -> dict[str, float]:
+        return self.learner.evaluate()
+
+    # --- pure delegation ---
+
+    @property
+    def callbacks(self):  # type: ignore[override]
+        return self.learner.callbacks
+
+    @property
+    def epochs(self) -> int:  # type: ignore[override]
+        return self.learner.epochs
+
+    def set_addr(self, addr: str) -> None:
+        self.learner.set_addr(addr)
+
+    def get_addr(self) -> str:
+        return self.learner.get_addr()
+
+    def set_model(self, model: Union[TpflModel, list, bytes]) -> None:
+        self.learner.set_model(model)
+
+    def get_model(self) -> TpflModel:
+        return self.learner.get_model()
+
+    def set_data(self, data: TpflDataset) -> None:
+        self.learner.set_data(data)
+
+    def get_data(self) -> TpflDataset:
+        return self.learner.get_data()
+
+    def set_epochs(self, epochs: int) -> None:
+        self.learner.set_epochs(epochs)
+
+    def update_callbacks_with_model_info(self) -> None:
+        self.learner.update_callbacks_with_model_info()
+
+    def add_callback_info_to_model(self) -> None:
+        self.learner.add_callback_info_to_model()
+
+    def get_framework(self) -> str:
+        return self.learner.get_framework()
+
+    def get_num_samples(self) -> int:
+        return self.learner.get_num_samples()
+
+
+def try_init_learner_with_simulation(learner: Learner) -> Learner:
+    """Wrap ``learner`` for pooled simulation unless disabled (reference
+    activation hook ``simulation/__init__.py:16-33``)."""
+    if Settings.DISABLE_SIMULATION or isinstance(learner, VirtualNodeLearner):
+        return learner
+    return VirtualNodeLearner(learner)

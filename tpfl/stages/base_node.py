@@ -159,12 +159,27 @@ class TrainStage(Stage):
         st = node.state
         node.aggregator.set_nodes_to_aggregate(st.train_set)
 
+        # Replay partial models that arrived before this round opened
+        # (stashed by PartialModelCommand; see NodeState.pending_partials).
+        for args in st.drain_pending_partials(st.round):
+            source, rnd, weights, contributors, num_samples = args
+            PartialModelCommand(node).execute(
+                source,
+                rnd,
+                weights=weights,
+                contributors=contributors,
+                num_samples=num_samples,
+            )
+
         TrainStage._evaluate(node)
         if check_early_stop(node):
             node.aggregator.clear()
             return None
 
         logger.info(node.addr, f"Training (round {st.round})")
+        # All train-set peers fit around now; the simulation pool can
+        # batch the in-process members into one vmapped program.
+        node.learner.set_fit_group_hint(list(st.train_set))
         node.learner.fit()
         if check_early_stop(node):
             node.aggregator.clear()
