@@ -207,3 +207,52 @@ def test_scaffold_requires_callback():
     assert Scaffold("t").get_required_callbacks() == ["scaffold"]
     assert FedProx("t").get_required_callbacks() == ["fedprox"]
     assert FedAvg("t").get_required_callbacks() == []
+
+
+def test_fedprox_callback_instantiable_and_mu_transport():
+    from tpfl.learning.callbacks import CallbackFactory
+
+    (cb,) = CallbackFactory.create(FedProx("t").get_required_callbacks())
+    assert cb.get_name() == "fedprox"
+    assert cb.prox_mu() == cb.DEFAULT_MU
+    cb.set_info({"mu": 0.5})
+    assert cb.prox_mu() == 0.5
+
+    # The aggregator ships mu on the aggregated model.
+    agg = FedProx("t", proximal_mu=0.123)
+    out = agg.aggregate([mk_model(1.0, 4, ["a"]), mk_model(3.0, 4, ["b"])])
+    assert out.get_info("fedprox") == {"mu": 0.123}
+
+
+def test_fedprox_proximal_term_pulls_toward_anchor():
+    """With a strong (but stable: lr*mu < 2(1+momentum)) mu the
+    proximal pull dominates and parameters stay near the round-start
+    anchor; with mu=0 they move freely."""
+    import numpy as np
+
+    from tpfl.learning.dataset import synthetic_mnist
+    from tpfl.learning.jax_learner import JaxLearner
+    from tpfl.models import create_model
+
+    def drift(mu):
+        ds = synthetic_mnist(n_train=128, n_test=16, seed=0)
+        model = create_model("mlp", (28, 28), seed=1, hidden_sizes=(16,))
+        ln = JaxLearner(
+            model=model,
+            data=ds,
+            addr="prox-node",
+            aggregator=FedProx("prox-node", proximal_mu=mu),
+            learning_rate=0.1,
+            batch_size=32,
+        )
+        (cb,) = [c for c in ln.callbacks if c.get_name() == "fedprox"]
+        cb.set_info({"mu": mu})
+        before = [np.asarray(x) for x in ln.get_model().get_parameters_list()]
+        ln.set_epochs(2)
+        ln.fit()
+        after = [np.asarray(x) for x in ln.get_model().get_parameters_list()]
+        return sum(float(np.abs(a - b).sum()) for a, b in zip(after, before))
+
+    free = drift(0.0)
+    pinned = drift(10.0)
+    assert pinned < free * 0.3, (free, pinned)

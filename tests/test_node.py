@@ -151,6 +151,149 @@ def test_interrupt_learning():
             nd.stop()
 
 
+def test_six_nodes_non_elected_path():
+    """6 nodes, train set 4: two nodes per round take
+    WaitAggregatedModelsStage + FullModel diffusion — the non-elected
+    path the reference exercises at 6 nodes (node_test.py:80-135)."""
+    n, rounds = 6, 2
+    assert Settings.TRAIN_SET_SIZE == 4
+    nodes = build_nodes(n)
+    try:
+        matrix = TopologyFactory.generate_matrix(TopologyType.FULL, n)
+        TopologyFactory.connect_nodes(matrix, nodes)
+        wait_convergence(nodes, n - 1, only_direct=False, wait=10)
+        nodes[0].set_start_learning(rounds=rounds, epochs=1)
+        wait_to_finish(nodes, timeout=240)
+
+        waited = 0
+        for nd in nodes:
+            assert_stage_history(nd, rounds, None)
+            waited += nd.learning_workflow.history.count(
+                "WaitAggregatedModelsStage"
+            )
+        # 2 non-elected nodes per round must have taken the wait path.
+        assert waited == (n - Settings.TRAIN_SET_SIZE) * rounds, waited
+        # ... and still hold the aggregated model (FullModel diffusion).
+        check_equal_models(nodes)
+    finally:
+        for nd in nodes:
+            nd.stop()
+
+
+def test_scaffold_e2e():
+    """4-node federation under Scaffold: the partial_aggregation=False
+    protocol path (TrainStage waits for ALL models) in vivo."""
+    from tpfl.learning.aggregators import Scaffold
+
+    n, rounds = 4, 2
+    ds = synthetic_mnist(n_train=200 * n, n_test=40 * n, seed=0, noise=0.4)
+    parts = ds.generate_partitions(n, RandomIIDPartitionStrategy, seed=1)
+    nodes = [
+        Node(
+            create_model("mlp", (28, 28), seed=7, hidden_sizes=(32,)),
+            parts[i],
+            aggregator=Scaffold(),
+            learning_rate=0.1,
+            batch_size=32,
+        )
+        for i in range(n)
+    ]
+    for nd in nodes:
+        nd.start()
+    try:
+        TopologyFactory.connect_nodes(
+            TopologyFactory.generate_matrix(TopologyType.FULL, n), nodes
+        )
+        wait_convergence(nodes, n - 1, only_direct=False, wait=10)
+        nodes[0].set_start_learning(rounds=rounds, epochs=1)
+        wait_to_finish(nodes, timeout=240)
+        for nd in nodes:
+            assert_stage_history(nd, rounds, None)
+        check_equal_models(nodes)
+        accs = [nd.learner.evaluate()["test_metric"] for nd in nodes]
+        assert all(a > 0.5 for a in accs), accs
+    finally:
+        for nd in nodes:
+            nd.stop()
+
+
+def test_fedprox_e2e():
+    """3-node federation under FedProx converges; mu rides the
+    aggregated model info into every learner's callback."""
+    from tpfl.learning.aggregators import FedProx
+
+    n, rounds = 3, 2
+    ds = synthetic_mnist(n_train=200 * n, n_test=40 * n, seed=0, noise=0.4)
+    parts = ds.generate_partitions(n, RandomIIDPartitionStrategy, seed=1)
+    nodes = [
+        Node(
+            create_model("mlp", (28, 28), seed=7, hidden_sizes=(32,)),
+            parts[i],
+            aggregator=FedProx(proximal_mu=0.05),
+            learning_rate=0.1,
+            batch_size=32,
+        )
+        for i in range(n)
+    ]
+    for nd in nodes:
+        nd.start()
+    try:
+        TopologyFactory.connect_nodes(
+            TopologyFactory.generate_matrix(TopologyType.FULL, n), nodes
+        )
+        wait_convergence(nodes, n - 1, only_direct=False, wait=10)
+        nodes[0].set_start_learning(rounds=rounds, epochs=1)
+        wait_to_finish(nodes, timeout=240)
+        check_equal_models(nodes)
+        accs = [nd.learner.evaluate()["test_metric"] for nd in nodes]
+        assert all(a > 0.5 for a in accs), accs
+        for nd in nodes:
+            cbs = [c for c in nd.learner.callbacks if c.get_name() == "fedprox"]
+            assert cbs and cbs[0].prox_mu() == 0.05
+    finally:
+        for nd in nodes:
+            nd.stop()
+
+
+def test_node_down_mid_learning():
+    """A node dying mid-experiment must not stall the survivors
+    (working version of the reference's disabled node-down test,
+    node_test.py:168-199)."""
+    import threading
+    import time
+
+    n, rounds = 3, 3
+    nodes = build_nodes(n)
+    try:
+        TopologyFactory.connect_nodes(
+            TopologyFactory.generate_matrix(TopologyType.FULL, n), nodes
+        )
+        wait_convergence(nodes, n - 1, only_direct=False, wait=10)
+        nodes[0].set_start_learning(rounds=rounds, epochs=1)
+
+        def kill_late():
+            # Die once learning is underway (first round in flight).
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                if (nodes[2].state.round or 0) >= 1:
+                    break
+                time.sleep(0.05)
+            nodes[2].stop()
+
+        killer = threading.Thread(target=kill_late)
+        killer.start()
+        wait_to_finish(nodes[:2], timeout=240)
+        killer.join(timeout=10)
+
+        for nd in nodes[:2]:
+            h = nd.learning_workflow.history
+            assert h.count("RoundFinishedStage") == rounds, h
+        check_equal_models(nodes[:2])
+    finally:
+        for nd in nodes:
+            nd.stop()
+
+
 def test_node_lifecycle_errors():
     from tpfl.exceptions import NodeRunningException, ZeroRoundsException
 

@@ -3,14 +3,16 @@
 
 Server-side FedProx is identical to FedAvg; the difference is the
 ``mu/2 * ||w - w_global||^2`` proximal term added to each client's local
-loss, implemented here as the ``fedprox`` learner callback
-(:mod:`tpfl.learning.callbacks.fedprox_callback`). Listed in the build's
-target configs (BASELINE.md config 3).
+loss, implemented by the ``fedprox`` learner callback
+(``tpfl.learning.callbacks.FedProxCallback``) through the jitted step's
+traced anchor/mu inputs. Listed in the build's target configs
+(BASELINE.md config 3).
 """
 
 from __future__ import annotations
 
 from tpfl.learning.aggregators.fedavg import FedAvg
+from tpfl.learning.model import TpflModel
 
 
 class FedProx(FedAvg):
@@ -21,3 +23,10 @@ class FedProx(FedAvg):
     def __init__(self, node_name: str = "unknown", proximal_mu: float = 0.01) -> None:
         super().__init__(node_name)
         self.proximal_mu = float(proximal_mu)
+
+    def aggregate(self, models: list[TpflModel]) -> TpflModel:
+        out = super().aggregate(models)
+        # Ship mu to the clients: learner.set_model routes it into the
+        # fedprox callback via additional_info (SCAFFOLD's transport).
+        out.add_info("fedprox", {"mu": self.proximal_mu})
+        return out

@@ -55,6 +55,12 @@ class TpflCallback(ABC):
         None for no correction."""
         return None
 
+    def prox_mu(self) -> float:
+        """Proximal coefficient: the jitted step adds
+        ``mu * (w_t - w_round_start)`` to every gradient (FedProx). 0
+        disables the term (and costs nothing: mu is a traced input)."""
+        return 0.0
+
     def on_fit_end(
         self,
         initial_params: Any,
@@ -118,6 +124,26 @@ class ScaffoldCallback(TpflCallback):
         self._info["delta_c_i"] = delta_c
 
 
+class FedProxCallback(TpflCallback):
+    """Client-side FedProx (Li et al. 2018): proximal term
+    ``mu/2 * ||w - w_global||^2`` added to the local objective — i.e.
+    ``mu * (w_t - w_round_start)`` added to every gradient via the
+    jitted step's anchor/mu inputs (see
+    ``tpfl.learning.jax_learner.make_train_step``; the anchor is the
+    round-start parameters, which ARE the last global model).
+
+    The FedProx aggregator ships its ``proximal_mu`` inside the
+    aggregated model's info (``{"mu": ...}``); until the first
+    aggregate arrives the default below applies.
+    """
+
+    name = "fedprox"
+    DEFAULT_MU = 0.01
+
+    def prox_mu(self) -> float:
+        return float(self._info.get("mu", self.DEFAULT_MU))
+
+
 class CallbackFactory:
     """Name → callback class registry (reference callback_factory.py).
     Single-framework (everything is jax), so keys are plain names."""
@@ -140,3 +166,4 @@ class CallbackFactory:
 
 
 CallbackFactory.register(ScaffoldCallback)
+CallbackFactory.register(FedProxCallback)

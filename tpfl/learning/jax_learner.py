@@ -76,7 +76,12 @@ def make_train_step(
     vmapped batched path (tpfl.simulation.batched_fit) so the two can
     never drift numerically.
 
-    Returns ``step(state, x, y, correction) -> (state, (loss, acc))``.
+    Returns ``step(state, x, y, correction, anchor, mu) ->
+    (state, (loss, acc))``. ``correction`` is the constant per-round
+    gradient offset (SCAFFOLD's ``c - c_i``); ``anchor``/``mu`` give the
+    FedProx proximal pull ``mu * (w_t - w_global)``, which depends on
+    the CURRENT params and so cannot ride the constant correction. Both
+    are traced inputs — mu=0 shares the same compiled program.
     """
 
     def apply(params, aux, x, train):
@@ -88,7 +93,7 @@ def make_train_step(
             return logits, updates
         return module.apply(variables, x, train=train), aux
 
-    def step(state: TrainState, x, y, correction):
+    def step(state: TrainState, x, y, correction, anchor, mu):
         def loss_of(params):
             logits, new_aux = apply(params, state.aux_state, x, True)
             return loss_fn(logits, y).mean(), (logits, new_aux)
@@ -97,7 +102,13 @@ def make_train_step(
             loss_of, has_aux=True
         )(state.params)
         grads = jax.tree_util.tree_map(
-            lambda g, c: g + c.astype(g.dtype), grads, correction
+            lambda g, c, p, a: (
+                g + c.astype(g.dtype) + (mu * (p - a)).astype(g.dtype)
+            ),
+            grads,
+            correction,
+            state.params,
+            anchor,
         )
         state = state.apply_gradients(grads=grads)
         state = state.replace(aux_state=new_aux)
@@ -209,9 +220,11 @@ class JaxLearner(Learner):
         step = make_train_step(module, loss_fn, has_aux)
 
         @partial(jax.jit, donate_argnums=(0,))
-        def train_epoch(state: TrainState, xs, ys, correction):
+        def train_epoch(state: TrainState, xs, ys, correction, anchor, mu):
             state, (losses, accs) = jax.lax.scan(
-                lambda s, b: step(s, b[0], b[1], correction), state, (xs, ys)
+                lambda s, b: step(s, b[0], b[1], correction, anchor, mu),
+                state,
+                (xs, ys),
             )
             return state, jnp.mean(losses), jnp.mean(accs)
 
@@ -267,7 +280,7 @@ class JaxLearner(Learner):
         Shared verbatim by the batched simulation path
         (tpfl.simulation.batched_fit) so the two never drift.
 
-        Returns (model, initial_params, correction, batches)."""
+        Returns (model, initial_params, correction, prox_mu, batches)."""
         model = self.get_model()
         initial_params = model.get_parameters()
         for cb in self.callbacks:
@@ -285,8 +298,9 @@ class JaxLearner(Learner):
             correction = jax.tree_util.tree_map(
                 lambda p: jnp.zeros((), p.dtype), initial_params
             )
+        mu = sum(cb.prox_mu() for cb in self.callbacks)
         batches = self._train_data((Settings.SEED or 0) + _addr_seed(self._addr))
-        return model, initial_params, correction, batches
+        return model, initial_params, correction, mu, batches
 
     def finish_fit(
         self,
@@ -323,7 +337,7 @@ class JaxLearner(Learner):
         if self._train_epoch_fn is None:
             self._train_epoch_fn = self._build_train_epoch()
 
-        model, initial_params, correction, batches = self.prepare_fit()
+        model, initial_params, correction, mu, batches = self.prepare_fit()
         # Train on a copy: the state is donated to the compiled epoch,
         # which invalidates its buffers on TPU — the model's own params
         # must stay readable (gossip threads serve them mid-fit), and
@@ -346,7 +360,12 @@ class JaxLearner(Learner):
                 break
             xs, ys = batches.stacked(epoch=self._round_counter * 10_000 + epoch)
             state, loss, acc = self._train_epoch_fn(
-                state, jnp.asarray(xs), jnp.asarray(ys), correction
+                state,
+                jnp.asarray(xs),
+                jnp.asarray(ys),
+                correction,
+                initial_params,
+                jnp.float32(mu),
             )
             n_steps += xs.shape[0]
             if in_exp:

@@ -71,14 +71,14 @@ class BatchedFitProgram:
         module, opt, loss_fn = self._module, self._opt, self._loss_fn
         step = make_train_step(module, loss_fn, self._has_aux)
 
-        def local_fit(params, aux, correction, xs, ys, bmask):
+        def local_fit(params, aux, correction, anchor, mu, xs, ys, bmask):
             state = TrainState.create(
                 apply_fn=None, params=params, tx=opt, aux_state=aux
             )
 
             def batch_step(st, batch):
                 x, y, m = batch
-                st2, (loss, _acc) = step(st, x, y, correction)
+                st2, (loss, _acc) = step(st, x, y, correction, anchor, mu)
                 # Masked (padding) batches are exact no-ops.
                 keep = m > 0
                 st = jax.tree_util.tree_map(
@@ -104,6 +104,8 @@ class BatchedFitProgram:
         stacked_params: Any,
         stacked_aux: Any,
         stacked_corr: Any,
+        stacked_anchor: Any,
+        mus: np.ndarray,
         xs: np.ndarray,
         ys: np.ndarray,
         bmask: np.ndarray,
@@ -117,6 +119,8 @@ class BatchedFitProgram:
             stacked_params,
             stacked_aux,
             stacked_corr,
+            stacked_anchor,
+            jnp.asarray(mus),
             jnp.asarray(xs),
             jnp.asarray(ys),
             jnp.asarray(bmask),
@@ -184,7 +188,7 @@ def _run_chunk(prog: BatchedFitProgram, learners: list[JaxLearner]) -> None:
     epochs = learners[0].epochs
     jobs = []
     for ln in learners:
-        model, initial, correction, batches = ln.prepare_fit()
+        model, initial, correction, mu, batches = ln.prepare_fit()
         xs, ys = batches.stacked(epoch=ln._round_counter * 10_000)
         ln._round_counter += 1
         jobs.append(
@@ -193,6 +197,7 @@ def _run_chunk(prog: BatchedFitProgram, learners: list[JaxLearner]) -> None:
                 "model": model,
                 "initial": initial,
                 "correction": correction,
+                "mu": mu,
                 "xs": xs,
                 "ys": ys,
                 "num_samples": batches.num_samples,
@@ -238,18 +243,27 @@ def _run_chunk(prog: BatchedFitProgram, learners: list[JaxLearner]) -> None:
         for j in jobs
     ]
     corr_trees = [j["correction"] for j in jobs]
+    # Anchors (round-start params for the proximal pull) must be
+    # separate buffers from stacked_params — those are donated.
+    anchor_trees = [j["initial"] for j in jobs]
+    mus = [float(j["mu"]) for j in jobs]
     for _ in range(bucket - len(jobs)):
         param_trees.append(param_trees[0])
         aux_trees.append(aux_trees[0])
         corr_trees.append(corr_trees[0])
+        anchor_trees.append(anchor_trees[0])
+        mus.append(0.0)
     stacked_params = _stack(param_trees)
     stacked_aux = _stack(aux_trees)
     stacked_corr = _stack(corr_trees)
+    stacked_anchor = _stack(anchor_trees)
 
     new_params, new_aux, losses = prog.run(
         stacked_params,
         stacked_aux,
         stacked_corr,
+        stacked_anchor,
+        np.asarray(mus, np.float32),
         np.stack(xs_l),
         np.stack(ys_l),
         np.stack(mask_l),
