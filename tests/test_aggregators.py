@@ -245,8 +245,10 @@ def test_fedprox_proximal_term_pulls_toward_anchor():
             learning_rate=0.1,
             batch_size=32,
         )
+        # The aggregator seeds its configured mu at learner construction
+        # (round 1 must not run on a default coefficient).
         (cb,) = [c for c in ln.callbacks if c.get_name() == "fedprox"]
-        cb.set_info({"mu": mu})
+        assert cb.prox_mu() == mu
         before = [np.asarray(x) for x in ln.get_model().get_parameters_list()]
         ln.set_epochs(2)
         ln.fit()

@@ -121,3 +121,58 @@ def test_export_stacked_for_scan(mnist):
     assert np.array_equal(x, x2)
     x3, _ = batches.stacked(epoch=1)
     assert not np.array_equal(x, x3)
+
+
+# --- rendered (real-image) data -------------------------------------------
+
+
+def test_rendered_digits_deterministic_and_shaped():
+    from tpfl.learning.dataset import rendered_digits
+
+    a = rendered_digits(n_train=40, n_test=10, seed=3)
+    b = rendered_digits(n_train=40, n_test=10, seed=3)
+    xa = np.asarray(a.get_split(True)["image"])
+    xb = np.asarray(b.get_split(True)["image"])
+    assert xa.shape == (40, 28, 28)
+    np.testing.assert_array_equal(xa, xb)
+    # Real strokes, not Gaussian blobs: most of the canvas stays dark and
+    # per-class images differ between samples (font/rotation variation).
+    assert 0.02 < xa.mean() < 0.5
+    labels = np.asarray(a.get_split(True)["label"])
+    same = [i for i in range(1, 40) if labels[i] == labels[0]]
+    assert same and not np.array_equal(xa[0], xa[same[0]])
+
+
+def test_rendered_color_digits_shape():
+    from tpfl.learning.dataset import rendered_color_digits
+
+    ds = rendered_color_digits(n_train=12, n_test=4, seed=0)
+    x = np.asarray(ds.get_split(True)["image"])
+    assert x.shape == (12, 32, 32, 3)
+    assert x.min() >= 0.0 and x.max() <= 1.0
+
+
+def test_from_huggingface_path(monkeypatch):
+    """from_huggingface routes through datasets.load_dataset (the real-MNIST
+    entry point, reference examples/mnist.py:173) — exercised hermetically."""
+    import datasets as hf
+
+    import tpfl.learning.dataset.tpfl_dataset as mod
+
+    def fake_load(name, **kwargs):
+        assert name == "p2pfl/MNIST"
+        n = 20
+        rng = np.random.default_rng(0)
+        split = hf.Dataset.from_dict(
+            {
+                "image": list(rng.random((n, 28, 28)).astype(np.float32)),
+                "label": list(rng.integers(0, 10, n).astype(np.int32)),
+            }
+        )
+        return hf.DatasetDict({"train": split, "test": split})
+
+    monkeypatch.setattr(mod, "load_dataset", fake_load)
+    ds = TpflDataset.from_huggingface("p2pfl/MNIST")
+    assert ds.num_samples(True) == 20
+    parts = ds.generate_partitions(2, RandomIIDPartitionStrategy, seed=0)
+    assert sum(p.num_samples(True) for p in parts) == 20

@@ -126,10 +126,10 @@ def test_interrupt_fit_stops_after_current_epoch(mnist):
     orig = learner._build_train_epoch()
     calls = []
 
-    def wrapper(state, xs, ys, corr):
+    def wrapper(state, xs, ys, *rest):
         calls.append(1)
         learner.interrupt_fit()  # lands mid-fit, checked next epoch
-        return orig(state, xs, ys, corr)
+        return orig(state, xs, ys, *rest)
 
     learner._train_epoch_fn = wrapper
     model = learner.fit()

@@ -311,3 +311,38 @@ def test_node_lifecycle_errors():
         node.set_start_learning(0, 1)
     node.stop()
     node.stop()  # idempotent
+
+
+def test_accuracy_contract_on_rendered_images():
+    """The reference's real-data parity gate (``test/node_test.py:128-132``):
+    accuracy > 0.5 + cross-node model agreement after 2 rounds — run on
+    rendered digit *images* (the zero-egress stand-in for HF MNIST), not
+    Gaussian prototypes."""
+    from tpfl.learning.dataset import rendered_digits
+
+    n, rounds = 3, 2
+    ds = rendered_digits(n_train=1000 * n, n_test=150 * n, seed=5)
+    parts = ds.generate_partitions(n, RandomIIDPartitionStrategy, seed=2)
+    nodes = [
+        Node(
+            create_model("mlp", (28, 28), seed=7, hidden_sizes=(64,)),
+            parts[i],
+            learning_rate=0.1,
+            batch_size=50,
+        )
+        for i in range(n)
+    ]
+    for nd in nodes:
+        nd.start()
+    try:
+        matrix = TopologyFactory.generate_matrix(TopologyType.FULL, n)
+        TopologyFactory.connect_nodes(matrix, nodes)
+        wait_convergence(nodes, n - 1, only_direct=False, wait=10)
+        nodes[0].set_start_learning(rounds=rounds, epochs=2)
+        wait_to_finish(nodes, timeout=240)
+        check_equal_models(nodes)
+        accs = [nd.learner.evaluate()["test_metric"] for nd in nodes]
+        assert all(a > 0.5 for a in accs), accs
+    finally:
+        for nd in nodes:
+            nd.stop()

@@ -66,6 +66,13 @@ class Aggregator(ABC):
     def get_required_callbacks(self) -> list[str]:
         return list(self.REQUIRED_CALLBACKS)
 
+    def initial_callback_info(self, name: str) -> dict:
+        """Config a required callback should start with *before* the
+        first aggregated model arrives (e.g. FedProx ships its
+        ``proximal_mu`` here so round 1 already uses the configured
+        coefficient, not a default)."""
+        return {}
+
     # --- round lifecycle ---
 
     def set_nodes_to_aggregate(self, nodes: list[str]) -> None:

@@ -24,6 +24,12 @@ class FedProx(FedAvg):
         super().__init__(node_name)
         self.proximal_mu = float(proximal_mu)
 
+    def initial_callback_info(self, name: str) -> dict:
+        # Round 1 runs before any aggregate ships mu — seed it at
+        # learner construction so the configured coefficient applies
+        # from the first local fit.
+        return {"mu": self.proximal_mu} if name == "fedprox" else {}
+
     def aggregate(self, models: list[TpflModel]) -> TpflModel:
         out = super().aggregate(models)
         # Ship mu to the clients: learner.set_model routes it into the

@@ -15,6 +15,10 @@ from tpfl.learning.dataset.partition_strategies import (
     PercentageBasedNonIIDPartitionStrategy,
     RandomIIDPartitionStrategy,
 )
+from tpfl.learning.dataset.rendered import (
+    rendered_color_digits,
+    rendered_digits,
+)
 from tpfl.learning.dataset.synthetic import (
     synthetic_cifar10,
     synthetic_classification,
@@ -31,6 +35,8 @@ __all__ = [
     "LabelSkewedPartitionStrategy",
     "DirichletPartitionStrategy",
     "PercentageBasedNonIIDPartitionStrategy",
+    "rendered_digits",
+    "rendered_color_digits",
     "synthetic_mnist",
     "synthetic_cifar10",
     "synthetic_classification",

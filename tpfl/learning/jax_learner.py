@@ -274,7 +274,7 @@ class JaxLearner(Learner):
 
     # --- Learner API ---
 
-    def prepare_fit(self) -> tuple[TpflModel, Any, Any, Any]:
+    def prepare_fit(self) -> tuple[TpflModel, Any, Any, Any, Any]:
         """Host-side pre-fit lifecycle: callbacks see round-start params
         and may contribute a gradient correction (zeros otherwise).
         Shared verbatim by the batched simulation path

@@ -39,6 +39,10 @@ class Learner(ABC):
         # learner.py:52-53 via CallbackFactory).
         names = aggregator.get_required_callbacks() if aggregator else []
         self.callbacks: list[TpflCallback] = CallbackFactory.create(names)
+        for cb in self.callbacks:
+            info = aggregator.initial_callback_info(cb.get_name())
+            if info:
+                cb.set_info(info)
 
     # --- wiring ---
 
