@@ -176,7 +176,9 @@ class Aggregator(ABC):
             missing = self.get_missing_models()
             logger.warning(
                 self.node_name,
-                f"Aggregation timed out; proceeding without {missing}",
+                f"Aggregation timed out; proceeding without {missing} "
+                f"(train_set={self._train_set}, held="
+                f"{[m.get_contributors() for m in models]})",
             )
         if not models:
             raise NoModelsToAggregateError(

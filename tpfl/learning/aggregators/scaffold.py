@@ -63,7 +63,9 @@ class Scaffold(Aggregator):
             if not info or "delta_y_i" not in info or "delta_c_i" not in info:
                 raise ValueError(
                     "SCAFFOLD requires delta_y_i/delta_c_i in model info "
-                    "(is the 'scaffold' callback registered on the learner?)"
+                    "(is the 'scaffold' callback registered on the learner?) "
+                    f"— offending model contributors={m.get_contributors()}, "
+                    f"info keys={sorted(m.get_info() or {})}"
                 )
             delta_ys.append(
                 jax.tree_util.tree_map(jnp.asarray, info["delta_y_i"])

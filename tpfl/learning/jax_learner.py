@@ -320,15 +320,26 @@ class JaxLearner(Learner):
             cb.on_fit_end(
                 initial_params, final_params, n_steps, self.learning_rate
             )
-        self.add_callback_info_to_model()
+        self.add_callback_info_to_model(model)
+        # Record the fitted model: callers (pool submit_fit, TrainStage)
+        # must receive THIS object, not learner.get_model(), which a
+        # concurrent FullModelCommand may have rebound to the round's
+        # aggregate while we were training.
+        self._last_fit_model = model
 
-    def skip_fit(self) -> TpflModel:
+    def skip_fit(self, model: Optional[TpflModel] = None) -> TpflModel:
         """Interrupted (or epochs=0) before any step: model unchanged,
         zero FL weight, and no fabricated callback deltas — a node that
         did no training must not move the global control variates or
-        count in the weighted mean."""
-        model = self.get_model()
+        count in the weighted mean.
+
+        ``model``: the model the (aborted) fit started with. In-fit
+        callers must pass it — the learner's current model may have been
+        rebound to the round aggregate by a concurrent FullModelCommand,
+        and the aggregate's metadata must not be clobbered."""
+        model = model if model is not None else self.get_model()
         model.set_contribution([self._addr], 0)
+        self._last_fit_model = model
         return model
 
     def fit(self) -> TpflModel:
@@ -379,7 +390,7 @@ class JaxLearner(Learner):
         self._round_counter += 1
 
         if n_steps == 0:
-            return self.skip_fit()
+            return self.skip_fit(model)
 
         self.finish_fit(
             model,

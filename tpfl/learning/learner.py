@@ -60,7 +60,16 @@ class Learner(ABC):
         else:
             if self._model is None:
                 raise ValueError("No base model to set parameters into")
-            self._model.set_parameters(model)
+            if isinstance(model, bytes):
+                # REBIND, don't mutate: wire bytes carry contributors +
+                # info, and the current object may be mid-fit on the
+                # training thread (a lapped trainer receiving the round's
+                # full model). Overwriting it in place would poison the
+                # fit's returned contribution with the aggregate's
+                # metadata (contributors = whole train set).
+                self._model = self._model.build_copy(params=model)
+            else:
+                self._model.set_parameters(model)
         self.update_callbacks_with_model_info()
 
     def get_model(self) -> TpflModel:
@@ -98,12 +107,18 @@ class Learner(ABC):
             if info is not None:
                 cb.set_info(info)
 
-    def add_callback_info_to_model(self) -> None:
-        """Collect callback state into the model for the aggregator."""
-        if self._model is None:
+    def add_callback_info_to_model(self, model: "Optional[TpflModel]" = None) -> None:
+        """Collect callback state into the model for the aggregator.
+
+        ``model`` defaults to the learner's current model, but fit paths
+        must pass the model they actually trained — the learner's may
+        have been rebound to the round aggregate by a concurrent
+        FullModelCommand (lapped trainer)."""
+        model = model if model is not None else self._model
+        if model is None:
             return
         for cb in self.callbacks:
-            self._model.add_info(cb.get_name(), cb.get_info())
+            model.add_info(cb.get_name(), cb.get_info())
 
     # --- abstract (reference learner.py:137-167) ---
 

@@ -113,8 +113,8 @@ class Settings:
         cls.GOSSIP_EXIT_ON_X_EQUAL_ROUNDS = 10
         cls.TRAIN_SET_SIZE = 4
         cls.SIM_BATCH_WINDOW = 0.05
-        cls.VOTE_TIMEOUT = 10.0
-        cls.AGGREGATION_TIMEOUT = 10.0
+        cls.VOTE_TIMEOUT = 30.0
+        cls.AGGREGATION_TIMEOUT = 30.0
         cls.WAIT_HEARTBEATS_CONVERGENCE = 0.2
         cls.LOG_LEVEL = "DEBUG"
         cls.ASYNC_LOGGER = False

@@ -106,7 +106,11 @@ class SuperLearnerPool:
         job.done.wait()
         if job.error is not None:
             raise job.error
-        return learner.get_model()
+        # The model finish_fit produced — NOT learner.get_model(), which
+        # a concurrent FullModelCommand (lapped trainer) may have rebound
+        # to the round's aggregate.
+        fitted = getattr(learner, "_last_fit_model", None)
+        return fitted if fitted is not None else learner.get_model()
 
     # --- dispatcher ---
 

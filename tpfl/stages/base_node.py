@@ -180,12 +180,18 @@ class TrainStage(Stage):
         # All train-set peers fit around now; the simulation pool can
         # batch the in-process members into one vmapped program.
         node.learner.set_fit_group_hint(list(st.train_set))
-        node.learner.fit()
+        # Use fit()'s returned model, NOT learner.get_model(): a slow
+        # trainer can be lapped — peers finish the round without us and
+        # their GossipModelStage replaces our learner's model with the
+        # aggregated full model (contributors = whole train set, no
+        # per-client callback info) mid-fit, which must never enter our
+        # own aggregator.
+        fitted = node.learner.fit()
         if check_early_stop(node):
             node.aggregator.clear()
             return None
 
-        covered = node.aggregator.add_model(node.learner.get_model())
+        covered = node.aggregator.add_model(fitted)
         st.set_models_aggregated(node.addr, covered)
         node.communication.broadcast(
             node.communication.build_msg(
@@ -239,13 +245,42 @@ class TrainStage(Stage):
             node.aggregator.clear()
             return None
 
-        try:
-            agg_model = node.aggregator.wait_and_get_aggregation()
-        except NoModelsToAggregateError:
-            logger.error(node.addr, "Nothing aggregated this round")
-            return GossipModelStage
-        node.learner.set_model(agg_model)
-        st.last_full_model_round = st.round if st.round is not None else -1
+        # Wait for coverage, but notice being lapped: if the round's
+        # full model already arrived (FullModelCommand sets
+        # last_full_model_round), the round is decided — adopt it
+        # instead of burning the whole aggregation timeout.
+        deadline = time.time() + Settings.AGGREGATION_TIMEOUT
+        lapped = False
+        while node.aggregator.is_open() and time.time() < deadline:
+            if check_early_stop(node):
+                node.aggregator.clear()
+                return None
+            if st.round is not None and st.last_full_model_round >= st.round:
+                lapped = True
+                break
+            # FullModelCommand sets this event; coverage completion is
+            # polled via is_open (the aggregator's own event is
+            # consumed by wait_and_get_aggregation below).
+            st.aggregated_model_event.wait(timeout=0.1)
+            st.aggregated_model_event.clear()
+        if lapped:
+            logger.info(
+                node.addr,
+                "Lapped: round result arrived while training; adopting it",
+            )
+        else:
+            try:
+                agg_model = node.aggregator.wait_and_get_aggregation(
+                    timeout=max(0.0, deadline - time.time())
+                )
+                node.learner.set_model(agg_model)
+            except NoModelsToAggregateError:
+                logger.error(node.addr, "Nothing aggregated this round")
+                return GossipModelStage
+            except Exception as e:  # survive a poisoned/partial aggregate
+                logger.error(node.addr, f"Aggregation failed: {e}")
+                return GossipModelStage
+            st.last_full_model_round = st.round if st.round is not None else -1
         node.communication.broadcast(
             node.communication.build_msg(
                 ModelsReadyCommand.name, [], round=st.round
