@@ -1,0 +1,45 @@
+"""Attack injection — the fork's raison d'être (SURVEY §2.8).
+
+The reference corrupts one node's weights after init
+(``exp_SAVE3.txt:60-113`` sign-flip, ``:187-234`` additive noise) and
+measures the effect on federation metrics. Here attacks are first-class:
+
+- pure, jit-friendly parameter transforms (:func:`sign_flip`,
+  :func:`additive_noise`) applied through
+  ``TpflModel.apply_to_params``;
+- :func:`poison_model` — one-shot corruption (reference parity);
+- :class:`AdversarialLearner` — a persistent model-poisoning adversary
+  that re-applies its attack to every local fit before the update
+  enters aggregation (the threat model Krum/TrimmedMean defend
+  against; the robust aggregators live in
+  ``tpfl.learning.aggregators.robust``).
+
+See :mod:`tpfl.attacks.harness` for the seeded reproducibility harness
+(``exp_SAVE3.txt:282-332``).
+"""
+
+from tpfl.attacks.attacks import (
+    AdversarialLearner,
+    additive_noise,
+    make_adversary,
+    poison_model,
+    sign_flip,
+)
+from tpfl.attacks.harness import (
+    assert_tables_allclose,
+    flatten_table,
+    metric_table,
+    run_seeded_experiment,
+)
+
+__all__ = [
+    "sign_flip",
+    "additive_noise",
+    "poison_model",
+    "AdversarialLearner",
+    "make_adversary",
+    "run_seeded_experiment",
+    "metric_table",
+    "flatten_table",
+    "assert_tables_allclose",
+]

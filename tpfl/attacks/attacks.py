@@ -1,0 +1,161 @@
+"""Parameter-poisoning attacks as pure pytree transforms.
+
+Reference behavior (``exp_SAVE3.txt``): ``__train_with_sign_flip``
+negates every weight of one node post-init (:60-113);
+``__train_with_additive_noise`` adds ``N(0, std)`` noise (:187-234).
+Both are one-shot there. This module keeps that parity
+(:func:`poison_model`) and adds the persistent variant the robust
+aggregators are actually built to resist: a learner wrapper that
+poisons *every* local update before it is gossiped.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from tpfl.learning.dataset.tpfl_dataset import TpflDataset
+from tpfl.learning.learner import Learner
+from tpfl.learning.model import TpflModel
+
+AttackFn = Callable[[Any], Any]  # pytree -> pytree
+
+
+def sign_flip() -> AttackFn:
+    """Negate every parameter (reference exp_SAVE3.txt:89-100)."""
+
+    def attack(params: Any) -> Any:
+        return jax.tree_util.tree_map(lambda x: -x, params)
+
+    attack.name = "sign_flip"  # type: ignore[attr-defined]
+    return attack
+
+
+def additive_noise(std: float = 0.1, seed: int = 0) -> AttackFn:
+    """Add ``N(0, std)`` Gaussian noise to every parameter (reference
+    exp_SAVE3.txt:213-223). Deterministic per (seed, application
+    counter, leaf index) — two seeded runs poison identically."""
+    counter = {"n": 0}
+
+    def attack(params: Any) -> Any:
+        base = jax.random.PRNGKey(seed)
+        base = jax.random.fold_in(base, counter["n"])
+        counter["n"] += 1
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        out = []
+        for i, leaf in enumerate(leaves):
+            k = jax.random.fold_in(base, i)
+            noise = jax.random.normal(k, jnp.shape(leaf), jnp.float32)
+            out.append(leaf + (std * noise).astype(jnp.asarray(leaf).dtype))
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    attack.name = f"additive_noise(std={std})"  # type: ignore[attr-defined]
+    return attack
+
+
+def poison_model(model: TpflModel, attack: AttackFn) -> TpflModel:
+    """One-shot in-place corruption (reference parity: applied to the
+    adversary's model after node creation, exp_SAVE3.txt:89-100)."""
+    params = attack(model.get_parameters())
+    model.set_parameters(params)
+    return model
+
+
+class AdversarialLearner(Learner):
+    """Persistent model-poisoning adversary.
+
+    Wraps any :class:`Learner`; every ``fit()`` trains honestly, then
+    applies ``attack`` to the fitted parameters before the model enters
+    aggregation/gossip — a Byzantine client under the standard
+    model-poisoning threat model. With ``once=True`` the attack fires
+    only on the first fit (closer to the reference's one-shot init
+    corruption, but surviving the first aggregation wash-out).
+    """
+
+    def __init__(
+        self, inner: Learner, attack: AttackFn, once: bool = False
+    ) -> None:
+        # No super().__init__: this is a pure proxy — state, callbacks
+        # and data live on the wrapped learner.
+        self._inner = inner
+        self._attack = attack
+        self._once = once
+        self._fired = False
+        self._last_fit_model = None  # Learner contract (pool fit seam)
+
+    # --- the attack seam ---
+
+    def fit(self) -> TpflModel:
+        model = self._inner.fit()
+        if self._once and self._fired:
+            return model
+        self._fired = True
+        poisoned = self._attack(model.get_parameters())
+        model.set_parameters(poisoned)
+        self._last_fit_model = model
+        return model
+
+    # --- pure delegation ---
+
+    def set_addr(self, addr: str) -> None:
+        self._inner.set_addr(addr)
+
+    def get_addr(self) -> str:
+        return self._inner.get_addr()
+
+    def set_model(self, model: Union[TpflModel, list, bytes]) -> None:
+        self._inner.set_model(model)
+
+    def get_model(self) -> TpflModel:
+        return self._inner.get_model()
+
+    def set_data(self, data: TpflDataset) -> None:
+        self._inner.set_data(data)
+
+    def get_data(self) -> TpflDataset:
+        return self._inner.get_data()
+
+    def set_epochs(self, epochs: int) -> None:
+        self._inner.set_epochs(epochs)
+
+    def set_fit_group_hint(self, peers: "int | list[str]") -> None:
+        self._inner.set_fit_group_hint(peers)
+
+    def update_callbacks_with_model_info(self) -> None:
+        self._inner.update_callbacks_with_model_info()
+
+    def add_callback_info_to_model(self, model: Optional[TpflModel] = None) -> None:
+        self._inner.add_callback_info_to_model(model)
+
+    def interrupt_fit(self) -> None:
+        self._inner.interrupt_fit()
+
+    def evaluate(self) -> dict[str, float]:
+        return self._inner.evaluate()
+
+    def get_framework(self) -> str:
+        return self._inner.get_framework()
+
+    def get_num_samples(self) -> int:
+        return self._inner.get_num_samples()
+
+    @property
+    def callbacks(self):  # type: ignore[override]
+        return self._inner.callbacks
+
+    @property
+    def epochs(self):  # type: ignore[override]
+        return self._inner.epochs
+
+    @epochs.setter
+    def epochs(self, value: int) -> None:
+        self._inner.epochs = value
+
+
+def make_adversary(node: Any, attack: AttackFn, once: bool = False) -> Any:
+    """Turn a (not-yet-started) Node into an adversary by wrapping its
+    learner. Returns the node for chaining."""
+    node.learner = AdversarialLearner(node.learner, attack, once=once)
+    return node

@@ -1,0 +1,146 @@
+"""Seeded experiment harness — reproducibility + attack comparison.
+
+Reference: ``exp_SAVE3.txt:116-185`` (``__train_with_seed``), ``:282-332``
+(``test_global_training_reproducibility``: run two seeded experiments,
+flatten the global metric tables, compare). The tpfl version is generic:
+one entry point runs a seeded federation (optionally with adversaries),
+returns the experiment's global metric table, and helpers flatten /
+compare tables numerically.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from tpfl.attacks.attacks import AttackFn, make_adversary
+from tpfl.learning.dataset import RandomIIDPartitionStrategy, rendered_digits
+from tpfl.management.logger import logger
+from tpfl.models import create_model
+from tpfl.node import Node
+from tpfl.settings import Settings
+from tpfl.utils import (
+    TopologyFactory,
+    TopologyType,
+    wait_convergence,
+    wait_to_finish,
+)
+
+
+def run_seeded_experiment(
+    seed: int,
+    n: int,
+    rounds: int,
+    *,
+    epochs: int = 1,
+    adversaries: Optional[dict[int, AttackFn]] = None,
+    aggregator_factory: Optional[Callable[[], Any]] = None,
+    topology: TopologyType = TopologyType.STAR,
+    model_fn: Optional[Callable[[int], Any]] = None,
+    data_fn: Optional[Callable[[int], Any]] = None,
+    samples_per_node: int = 300,
+    learning_rate: float = 0.1,
+    batch_size: int = 50,
+    timeout: float = 240.0,
+) -> str:
+    """Run one seeded federation; returns the experiment name.
+
+    ``adversaries`` maps node index -> attack (persistent, applied to
+    every fit — see :class:`tpfl.attacks.AdversarialLearner`).
+    ``model_fn(seed)`` / ``data_fn(seed)`` override the default MLP /
+    rendered-digits pair. Reference: star topology, seeded settings
+    (exp_SAVE3.txt:116-156).
+    """
+    prev_seed = Settings.SEED
+    Settings.SEED = seed
+    nodes: list[Node] = []
+    try:
+        data = (
+            data_fn(seed)
+            if data_fn is not None
+            else rendered_digits(
+                n_train=samples_per_node * n,
+                n_test=max(100, samples_per_node * n // 5),
+                seed=seed,
+            )
+        )
+        parts = data.generate_partitions(
+            n, RandomIIDPartitionStrategy, seed=seed
+        )
+        for i in range(n):
+            model = (
+                model_fn(seed)
+                if model_fn is not None
+                else create_model("mlp", (28, 28), seed=seed)
+            )
+            # Pinned addresses: per-node shuffle/vote seeds derive from
+            # the address, and table comparison aligns by node name —
+            # auto-assigned (global-counter) names would make two
+            # identical runs differ.
+            node = Node(
+                model,
+                parts[i],
+                addr=f"seed{seed}-n{i}",
+                aggregator=(
+                    aggregator_factory() if aggregator_factory else None
+                ),
+                learning_rate=learning_rate,
+                batch_size=batch_size,
+            )
+            if adversaries and i in adversaries:
+                make_adversary(node, adversaries[i])
+            node.start()
+            nodes.append(node)
+
+        matrix = TopologyFactory.generate_matrix(topology, n)
+        TopologyFactory.connect_nodes(matrix, nodes)
+        wait_convergence(nodes, n - 1, only_direct=False, wait=30)
+        exp_name = nodes[0].set_start_learning(rounds=rounds, epochs=epochs)
+        wait_to_finish(nodes, timeout=timeout)
+        return exp_name
+    finally:
+        for node in nodes:
+            node.stop()
+        Settings.SEED = prev_seed
+
+
+def metric_table(exp_name: str) -> dict[str, dict[str, list]]:
+    """The experiment's global metric table:
+    ``{node: {metric: [(round, value), ...]}}``."""
+    return logger.get_global_logs().get(exp_name, {})
+
+
+def flatten_table(table: dict[str, dict[str, list]]) -> np.ndarray:
+    """Deterministic numeric flattening (reference __flatten_results,
+    exp_SAVE3.txt:335-336 region): sort by node then metric then round."""
+    out: list[float] = []
+    for node in sorted(table):
+        for metric in sorted(table[node]):
+            for rnd, value in sorted(table[node][metric]):
+                out.append(float(value))
+    return np.asarray(out, dtype=np.float64)
+
+
+def assert_tables_allclose(
+    a: dict[str, dict[str, list]],
+    b: dict[str, dict[str, list]],
+    atol: float = 1e-3,
+) -> None:
+    """Two seeded runs must produce numerically identical metric tables
+    up to float-reduction noise.
+
+    Aggregation math is canonically ordered (aggregator.py sorts by
+    contributors), but with partial aggregation the gossip *merge
+    topology* — which partial aggregates formed before full coverage —
+    still depends on scheduling, giving ~1e-4 drift over a few rounds.
+    Real divergence (seed/behavior differences) shows at 1e-1 scale;
+    the default atol sits between. The reference never asserted at all
+    (its np.allclose is commented out, exp_SAVE3.txt:301)."""
+    fa, fb = flatten_table(a), flatten_table(b)
+    if fa.shape != fb.shape:
+        raise AssertionError(
+            f"Metric tables differ in shape: {fa.shape} vs {fb.shape} "
+            f"(nodes {sorted(a)} vs {sorted(b)})"
+        )
+    np.testing.assert_allclose(fa, fb, atol=atol)

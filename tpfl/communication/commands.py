@@ -53,7 +53,8 @@ class StartLearningCommand(NodeCommand):
 
     def execute(self, source: str, round: int, args: list[str], **kwargs: Any) -> None:
         rounds, epochs = int(args[0]), int(args[1])
-        self.node.start_learning_thread(rounds, epochs)
+        exp_name = args[2] if len(args) > 2 else "experiment"
+        self.node.start_learning_thread(rounds, epochs, exp_name)
 
 
 class StopLearningCommand(NodeCommand):

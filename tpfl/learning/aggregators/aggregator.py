@@ -171,7 +171,12 @@ class Aggregator(ABC):
             timeout = Settings.AGGREGATION_TIMEOUT
         finished = self._finish_aggregation_event.wait(timeout=timeout)
         with self._lock:
-            models = list(self._models)
+            # Canonical order: gossip arrival order is scheduling noise,
+            # and float reduction order must not depend on it (seeded
+            # reproducibility, exp_SAVE3.txt:282-332).
+            models = sorted(
+                self._models, key=lambda m: tuple(sorted(m.get_contributors()))
+            )
         if not finished:
             missing = self.get_missing_models()
             logger.warning(
@@ -192,11 +197,14 @@ class Aggregator(ABC):
         (reference aggregator.py:224-270). Returns None if nothing to send."""
         except_nodes = except_nodes or []
         with self._lock:
-            usable = [
-                m
-                for m in self._models
-                if not (set(m.get_contributors()) & set(except_nodes))
-            ]
+            usable = sorted(
+                (
+                    m
+                    for m in self._models
+                    if not (set(m.get_contributors()) & set(except_nodes))
+                ),
+                key=lambda m: tuple(sorted(m.get_contributors())),
+            )
         if not usable:
             return None
         if len(usable) == 1:

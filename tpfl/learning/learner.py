@@ -35,6 +35,10 @@ class Learner(ABC):
         self._data = data
         self._addr = addr
         self.epochs: int = 1
+        # The model the most recent fit produced — what fit callers must
+        # consume (learner._model may be rebound by a concurrent
+        # FullModelCommand; see JaxLearner.finish_fit / pool.submit_fit).
+        self._last_fit_model: Optional[TpflModel] = None
         # Build the callbacks the aggregator requires (reference
         # learner.py:52-53 via CallbackFactory).
         names = aggregator.get_required_callbacks() if aggregator else []

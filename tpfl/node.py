@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import random
 import threading
+import uuid
 import zlib
 from typing import Any, Optional, Type
 
@@ -97,6 +98,7 @@ class Node:
         # Experiment parameters (set by set_start_learning / command)
         self.rounds: int = 0
         self.epochs: int = 1
+        self.exp_name: str = "experiment"
         self.learning_workflow = LearningWorkflow()
         self._learning_thread: Optional[threading.Thread] = None
         self._running = False
@@ -145,17 +147,21 @@ class Node:
 
     # --- learning (reference node.py:333-400) ---
 
-    def set_start_learning(self, rounds: int = 1, epochs: int = 1) -> None:
-        """Kick off a federated experiment from this node."""
+    def set_start_learning(self, rounds: int = 1, epochs: int = 1) -> str:
+        """Kick off a federated experiment from this node. Returns the
+        experiment name (unique per start; all nodes share it — the
+        reference's newer API returns it for metric retrieval,
+        exp_SAVE3.txt:107-113)."""
         if not self._running:
             raise NodeRunningException("Node must be started")
         if rounds < 1:
             raise ZeroRoundsException("rounds must be >= 1")
         if self.state.status == "Learning":
             raise LearnerRunningException("Already learning")
+        exp_name = f"experiment_{uuid.uuid4().hex[:8]}"
         self.communication.broadcast(
             self.communication.build_msg(
-                StartLearningCommand.name, [str(rounds), str(epochs)]
+                StartLearningCommand.name, [str(rounds), str(epochs), exp_name]
             )
         )
         # Initiator has the weights: release its own init event and
@@ -166,9 +172,12 @@ class Node:
         self.communication.broadcast(
             self.communication.build_msg(ModelInitializedCommand.name)
         )
-        self.start_learning_thread(rounds, epochs)
+        self.start_learning_thread(rounds, epochs, exp_name)
+        return exp_name
 
-    def start_learning_thread(self, rounds: int, epochs: int) -> None:
+    def start_learning_thread(
+        self, rounds: int, epochs: int, exp_name: str = "experiment"
+    ) -> None:
         """Spawn the stage-workflow thread (also the StartLearningCommand
         entry point for non-initiator nodes)."""
         if self._learning_thread is not None and self._learning_thread.is_alive():
@@ -176,6 +185,7 @@ class Node:
             return
         self.rounds = rounds
         self.epochs = epochs
+        self.exp_name = exp_name
         self.state.prepare_experiment()
         self.learning_workflow = LearningWorkflow()
         self._learning_thread = threading.Thread(

@@ -109,7 +109,7 @@ class SuperLearnerPool:
         # The model finish_fit produced — NOT learner.get_model(), which
         # a concurrent FullModelCommand (lapped trainer) may have rebound
         # to the round's aggregate.
-        fitted = getattr(learner, "_last_fit_model", None)
+        fitted = learner._last_fit_model
         return fitted if fitted is not None else learner.get_model()
 
     # --- dispatcher ---

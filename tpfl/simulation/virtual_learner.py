@@ -18,7 +18,7 @@ unless ``Settings.DISABLE_SIMULATION``.
 from __future__ import annotations
 
 import weakref
-from typing import Union
+from typing import Optional, Union
 
 from tpfl.learning.dataset.tpfl_dataset import TpflDataset
 from tpfl.learning.learner import Learner
@@ -36,6 +36,7 @@ class VirtualNodeLearner(Learner):
         # No super().__init__: all state lives in the wrapped learner.
         self.learner = learner
         self._group_hint: "int | list[str]" = 0
+        self._last_fit_model = None  # Learner contract (pool fit seam)
         _live_learners.add(self)
 
     @staticmethod
@@ -102,8 +103,8 @@ class VirtualNodeLearner(Learner):
     def update_callbacks_with_model_info(self) -> None:
         self.learner.update_callbacks_with_model_info()
 
-    def add_callback_info_to_model(self) -> None:
-        self.learner.add_callback_info_to_model()
+    def add_callback_info_to_model(self, model: Optional[TpflModel] = None) -> None:
+        self.learner.add_callback_info_to_model(model)
 
     def get_framework(self) -> str:
         return self.learner.get_framework()

@@ -14,7 +14,7 @@ the reference (each fixes a reference wart without changing semantics):
 from __future__ import annotations
 
 import time
-from typing import TYPE_CHECKING, Optional, Type
+from typing import TYPE_CHECKING, Callable, Optional, Type
 
 from tpfl.communication.commands import (
     FullModelCommand,
@@ -43,7 +43,7 @@ class StartLearningStage(Stage):
     @staticmethod
     def execute(node: "Node") -> Optional[Type[Stage]]:
         st = node.state
-        st.set_experiment(Experiment("experiment", node.rounds))
+        st.set_experiment(Experiment(node.exp_name, node.rounds))
         logger.experiment_started(node.addr, st.experiment)
         node.learner.set_epochs(node.epochs)
 
@@ -149,6 +149,27 @@ class VoteTrainSetStage(Stage):
         return TrainStage if node.addr in st.train_set else WaitAggregatedModelsStage
 
 
+def _await_round_result(
+    node: "Node", deadline: float, done_fn: "Optional[Callable[[], bool]]" = None
+) -> str:
+    """Shared round-result wait (TrainStage + WaitAggregatedModelsStage):
+    poll until the round's full model arrives (``"full_model"``), an
+    optional extra condition holds (``"done"`` — e.g. local aggregation
+    coverage), early stop (``"early_stop"``), or ``deadline``
+    (``"timeout"``). FullModelCommand sets ``aggregated_model_event``."""
+    st = node.state
+    while time.time() < deadline:
+        if check_early_stop(node):
+            return "early_stop"
+        if st.round is not None and st.last_full_model_round >= st.round:
+            return "full_model"
+        if done_fn is not None and done_fn():
+            return "done"
+        st.aggregated_model_event.wait(timeout=0.1)
+        st.aggregated_model_event.clear()
+    return "timeout"
+
+
 class TrainStage(Stage):
     """Reference train_stage.py:35-176."""
 
@@ -250,20 +271,13 @@ class TrainStage(Stage):
         # last_full_model_round), the round is decided — adopt it
         # instead of burning the whole aggregation timeout.
         deadline = time.time() + Settings.AGGREGATION_TIMEOUT
-        lapped = False
-        while node.aggregator.is_open() and time.time() < deadline:
-            if check_early_stop(node):
-                node.aggregator.clear()
-                return None
-            if st.round is not None and st.last_full_model_round >= st.round:
-                lapped = True
-                break
-            # FullModelCommand sets this event; coverage completion is
-            # polled via is_open (the aggregator's own event is
-            # consumed by wait_and_get_aggregation below).
-            st.aggregated_model_event.wait(timeout=0.1)
-            st.aggregated_model_event.clear()
-        if lapped:
+        status = _await_round_result(
+            node, deadline, done_fn=lambda: not node.aggregator.is_open()
+        )
+        if status == "early_stop":
+            node.aggregator.clear()
+            return None
+        if status == "full_model":
             logger.info(
                 node.addr,
                 "Lapped: round result arrived while training; adopting it",
@@ -273,14 +287,28 @@ class TrainStage(Stage):
                 agg_model = node.aggregator.wait_and_get_aggregation(
                     timeout=max(0.0, deadline - time.time())
                 )
-                node.learner.set_model(agg_model)
             except NoModelsToAggregateError:
+                # Deliberate empty-round case: no result to diffuse —
+                # finish the round instead of gossiping our local fit
+                # as if it were the aggregate.
                 logger.error(node.addr, "Nothing aggregated this round")
-                return GossipModelStage
-            except Exception as e:  # survive a poisoned/partial aggregate
+                return RoundFinishedStage
+            except Exception as e:  # byzantine/malformed peer payloads
                 logger.error(node.addr, f"Aggregation failed: {e}")
-                return GossipModelStage
-            st.last_full_model_round = st.round if st.round is not None else -1
+                return RoundFinishedStage
+            # A timed-out partial aggregate must not shadow the round's
+            # authoritative full model if one arrived while the (possibly
+            # slow, jit-compiling) aggregation math ran.
+            if st.round is not None and st.last_full_model_round >= st.round:
+                logger.info(
+                    node.addr, "Round result arrived during aggregation; adopting it"
+                )
+            else:
+                node.learner.set_model(agg_model)
+                if st.round is not None:
+                    st.last_full_model_round = max(
+                        st.last_full_model_round, st.round
+                    )
         node.communication.broadcast(
             node.communication.build_msg(
                 ModelsReadyCommand.name, [], round=st.round
@@ -313,14 +341,10 @@ class WaitAggregatedModelsStage(Stage):
     def execute(node: "Node") -> Optional[Type[Stage]]:
         st = node.state
         deadline = time.time() + Settings.AGGREGATION_TIMEOUT
-        while time.time() < deadline:
-            if check_early_stop(node):
-                return None
-            if st.round is not None and st.last_full_model_round >= st.round:
-                break
-            st.aggregated_model_event.wait(timeout=0.1)
-            st.aggregated_model_event.clear()
-        else:
+        status = _await_round_result(node, deadline)
+        if status == "early_stop":
+            return None
+        if status == "timeout":
             logger.warning(node.addr, "Aggregation wait timed out")
         node.communication.broadcast(
             node.communication.build_msg(
