@@ -143,3 +143,131 @@ def test_sharded_trainer_dp_and_fsdp():
                 for leaf in jax.tree_util.tree_leaves(params)
             ]
             assert any(s != jax.sharding.PartitionSpec() for s in shardings)
+
+
+# --- mutable collections (BatchNorm) through the TPU layer -----------------
+
+
+def _bn_cnn():
+    """Tiny BatchNorm'd conv net (the ResNet18 aux pattern, zoo.py:94,
+    cheap enough for the 8-device CPU mesh)."""
+    import flax.linen as nn
+
+    class BnCnn(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            if x.ndim == 3:
+                x = x[..., None]
+            x = nn.Conv(8, (3, 3))(x)
+            x = nn.relu(
+                nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+            )
+            x = jnp.mean(x, axis=(1, 2))
+            return nn.Dense(10)(x)
+
+    return BnCnn()
+
+
+def test_vmap_federation_batchnorm_round():
+    n = 8
+    mesh = create_mesh({"nodes": n})
+    fed = VmapFederation(_bn_cnn(), n, mesh=mesh, learning_rate=0.05)
+    params, aux = fed.init_state((28, 28))
+    assert "batch_stats" in aux
+    xs, ys = _node_data(n, n_batches=2, bs=8)
+    xs, ys = fed.shard_data(xs, ys)
+    aux0 = jax.tree_util.tree_map(np.asarray, aux)
+
+    new_params, new_aux, losses = fed.round(params, xs, ys, epochs=1, aux=aux)
+    assert losses.shape == (n,)
+    assert np.all(np.isfinite(np.asarray(losses)))
+    # Stats actually moved (train=True ran BN in batch-stats mode).
+    moved = jax.tree_util.tree_map(
+        lambda a, b: float(np.abs(np.asarray(a) - np.asarray(b)).max()),
+        aux0,
+        jax.tree_util.tree_map(np.asarray, new_aux),
+    )
+    assert max(jax.tree_util.tree_leaves(moved)) > 0
+    # aux_mode="mean" (default): every node holds identical stats.
+    for leaf in jax.tree_util.tree_leaves(new_aux):
+        leaf = np.asarray(leaf)
+        np.testing.assert_allclose(leaf, np.broadcast_to(leaf[:1], leaf.shape), atol=1e-6)
+    # And identical params (full diffusion).
+    for leaf in jax.tree_util.tree_leaves(new_params):
+        leaf = np.asarray(leaf)
+        np.testing.assert_allclose(leaf, np.broadcast_to(leaf[:1], leaf.shape), atol=1e-6)
+    # evaluate with aux works.
+    loss_e, acc_e = fed.evaluate(new_params, xs, ys, aux=new_aux)
+    assert np.all(np.isfinite(np.asarray(loss_e)))
+
+
+def test_vmap_federation_fedbn_keeps_local_stats():
+    n = 4
+    fed = VmapFederation(_bn_cnn(), n, learning_rate=0.05, aux_mode="local")
+    params, aux = fed.init_state((28, 28))
+    xs, ys = _node_data(n, n_batches=2, bs=8)
+    _, new_aux, _ = fed.round(params, jnp.asarray(xs), jnp.asarray(ys), aux=aux)
+    # Different nodes saw different data -> at least one stats leaf differs
+    # across the node axis (FedBN: stats stay private).
+    diffs = [
+        float(np.abs(np.asarray(l) - np.asarray(l)[:1]).max())
+        for l in jax.tree_util.tree_leaves(new_aux)
+    ]
+    assert max(diffs) > 0
+
+
+def test_init_params_rejects_bn_module():
+    fed = VmapFederation(_bn_cnn(), 2)
+    with pytest.raises(ValueError, match="init_state"):
+        fed.init_params((28, 28))
+
+
+def test_sharded_trainer_resnet18_with_aux():
+    from tpfl.models import ResNet18
+
+    mesh = create_mesh({"dp": 8})
+    tr = ShardedTrainer(
+        ResNet18(out_channels=10, stage_sizes=(1, 1), compute_dtype=jnp.float32),
+        mesh,
+        fsdp=False,
+        learning_rate=0.05,
+    )
+    params, aux, opt_state = tr.init_with_aux((16, 16, 3))
+    assert "batch_stats" in aux
+    rng = np.random.default_rng(0)
+    x, y = rng.random((16, 16, 16, 3), np.float32), rng.integers(0, 10, 16)
+    x, y = tr.shard_batch(x, jnp.asarray(y, jnp.int32))
+    losses = []
+    for _ in range(2):
+        params, aux, opt_state, loss = tr.train_step_with_aux(
+            params, aux, opt_state, x, y
+        )
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+
+
+def test_sharded_trainer_init_rejects_bn_module():
+    mesh = create_mesh({"dp": 8})
+    tr = ShardedTrainer(_bn_cnn(), mesh)
+    with pytest.raises(ValueError, match="init_with_aux"):
+        tr.init((28, 28))
+
+
+def test_fedbn_mask_keeps_nonparticipant_stats():
+    n = 4
+    fed = VmapFederation(_bn_cnn(), n, learning_rate=0.05, aux_mode="local")
+    params, aux = fed.init_state((28, 28))
+    xs, ys = _node_data(n, n_batches=2, bs=8)
+    weights = jnp.asarray([1.0, 1.0, 0.0, 0.0])
+    aux0 = jax.tree_util.tree_map(np.asarray, aux)
+    _, new_aux, _ = fed.round(
+        params, jnp.asarray(xs), jnp.asarray(ys), weights=weights, aux=aux
+    )
+    for old, new in zip(
+        jax.tree_util.tree_leaves(aux0), jax.tree_util.tree_leaves(new_aux)
+    ):
+        new = np.asarray(new)
+        # Non-participants (w=0): stats unchanged.
+        np.testing.assert_array_equal(new[2:], old[2:])
+        # Participants: stats moved.
+        assert np.abs(new[:2] - old[:2]).max() > 0

@@ -90,6 +90,7 @@ class AdversarialLearner(Learner):
     def fit(self) -> TpflModel:
         model = self._inner.fit()
         if self._once and self._fired:
+            self._last_fit_model = model  # honest fits must still land
             return model
         self._fired = True
         poisoned = self._attack(model.get_parameters())

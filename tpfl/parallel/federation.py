@@ -28,6 +28,39 @@ from tpfl.learning.jax_learner import cross_entropy_loss, default_optimizer
 from tpfl.parallel.mesh import federation_sharding, replicated
 
 
+def _masked_leaf_mean(weights: Any) -> Callable[[Any], Any]:
+    """Exact FedAvg reduction over the leading node axis: normalized
+    ``weights`` [N] (uniform fallback when all-zero), with masked-out
+    (w=0) nodes zeroed BEFORE the reduction — a w=0 node whose params
+    overflowed would otherwise contribute 0 * inf = NaN. On a sharded
+    mesh XLA lowers the einsum to an all-reduce over ICI (SURVEY §5.8)."""
+    total = jnp.sum(weights)
+    wnorm = jnp.where(
+        total > 0,
+        weights / jnp.maximum(total, 1e-9),
+        jnp.full_like(weights, 1.0 / weights.shape[0]),
+    )
+
+    def leaf_mean(p):
+        w = wnorm.astype(jnp.float32)
+        sel = w.reshape((-1,) + (1,) * (p.ndim - 1)) > 0
+        clean = jnp.where(sel, p.astype(jnp.float32), 0.0)
+        return jnp.einsum("n,n...->...", w, clean).astype(p.dtype)
+
+    return leaf_mean
+
+
+def _diffuse(tree: Any, weights: Any) -> Any:
+    """Masked FedAvg + full-model diffusion: every node receives the
+    aggregate (the FullModelCommand equivalent of the protocol path)."""
+    leaf_mean = _masked_leaf_mean(weights)
+    n = weights.shape[0]
+    agg = jax.tree_util.tree_map(leaf_mean, tree)
+    return jax.tree_util.tree_map(
+        lambda a: jnp.broadcast_to(a[None], (n, *a.shape)), agg
+    )
+
+
 class VmapFederation:
     """N-node federated training, vectorized over a ``nodes`` axis.
 
@@ -52,7 +85,10 @@ class VmapFederation:
         optimizer_factory: Optional[Callable] = None,
         loss_fn: Callable = cross_entropy_loss,
         seed: int = 0,
+        aux_mode: str = "mean",
     ) -> None:
+        if aux_mode not in ("mean", "local"):
+            raise ValueError(f"aux_mode must be 'mean' or 'local', got {aux_mode!r}")
         self.module = module
         self.n_nodes = int(n_nodes)
         self.mesh = mesh
@@ -60,27 +96,44 @@ class VmapFederation:
         self._opt = (optimizer_factory or default_optimizer)(learning_rate)
         self._loss_fn = loss_fn
         self.seed = seed
+        # Mutable collections (BatchNorm stats): "mean" = weighted-mean
+        # them like parameters (one consistent global model); "local" =
+        # keep each node's stats private (FedBN, Li et al. 2021).
+        self.aux_mode = aux_mode
         self._round_fn: Optional[Callable] = None
+        self._round_aux_fn: Optional[Callable] = None
         self._eval_fn: Optional[Callable] = None
+        self._eval_aux_fn: Optional[Callable] = None
 
     # --- params ---
 
-    def init_params(self, input_shape: tuple[int, ...]) -> Any:
-        """Stacked [N, ...] params, identical across nodes."""
+    def init_state(self, input_shape: tuple[int, ...]) -> tuple[Any, Any]:
+        """(stacked params, stacked aux) — aux is ``{}`` for modules
+        without mutable collections, else e.g. ``{"batch_stats": ...}``
+        stacked on the node axis (BatchNorm'd models: ResNet18)."""
         dummy = jnp.zeros((1, *input_shape), jnp.float32)
         variables = self.module.init(jax.random.PRNGKey(self.seed), dummy, train=False)
-        extra = [k for k in variables if k != "params"]
-        if extra:
-            raise NotImplementedError(
-                f"VmapFederation does not yet thread mutable collections "
-                f"{extra} (e.g. BatchNorm stats) through the vectorized "
-                f"round; use JaxLearner/Node for such models."
-            )
         params = variables["params"]
-        stacked = jax.tree_util.tree_map(
-            lambda p: jnp.broadcast_to(p[None], (self.n_nodes, *p.shape)), params
-        )
-        return self._shard(stacked)
+        aux = {k: v for k, v in variables.items() if k != "params"}
+
+        def stack(tree: Any) -> Any:
+            return jax.tree_util.tree_map(
+                lambda p: jnp.broadcast_to(p[None], (self.n_nodes, *p.shape)),
+                tree,
+            )
+
+        return self._shard(stack(params)), self._shard(stack(aux))
+
+    def init_params(self, input_shape: tuple[int, ...]) -> Any:
+        """Stacked [N, ...] params, identical across nodes (aux-free
+        modules; BatchNorm'd models use :meth:`init_state`)."""
+        params, aux = self.init_state(input_shape)
+        if aux:
+            raise ValueError(
+                f"Module has mutable collections {sorted(aux)} — use "
+                f"init_state() and pass aux to round()/evaluate()."
+            )
+        return params
 
     def _shard(self, tree: Any) -> Any:
         if self.mesh is None:
@@ -134,32 +187,9 @@ class VmapFederation:
             trained, losses = jax.vmap(
                 lambda p, x, y: local_train(p, x, y, epochs)
             )(params, xs, ys)
-            # Exact FedAvg over the node axis: the sharded reduction is
-            # XLA's all-reduce over ICI (SURVEY §5.8).
-            total = jnp.sum(weights)
-            wnorm = jnp.where(
-                total > 0,
-                weights / jnp.maximum(total, 1e-9),
-                jnp.full_like(weights, 1.0 / weights.shape[0]),
-            )
-
-            def leaf_mean(p):
-                # Zero masked-out nodes BEFORE the reduction: a w=0 node
-                # whose params overflowed would otherwise contribute
-                # 0 * inf = NaN to the aggregate.
-                w = wnorm.astype(jnp.float32)
-                sel = w.reshape((-1,) + (1,) * (p.ndim - 1)) > 0
-                clean = jnp.where(sel, p.astype(jnp.float32), 0.0)
-                return jnp.einsum("n,n...->...", w, clean).astype(p.dtype)
-
-            agg = jax.tree_util.tree_map(leaf_mean, trained)
             # Mask semantics: elected nodes (w>0) contribute; EVERY node
-            # receives the aggregate (full-model diffusion equivalent).
-            out = jax.tree_util.tree_map(
-                lambda a: jnp.broadcast_to(a[None], (weights.shape[0], *a.shape)),
-                agg,
-            )
-            return out, losses
+            # receives the aggregate.
+            return _diffuse(trained, weights), losses
 
         # epochs is positional-static: pjit rejects kwargs when
         # in_shardings is given.
@@ -174,6 +204,82 @@ class VmapFederation:
             out_shardings=(sharding, sharding),
         )
 
+    def _build_round_aux(self) -> Callable:
+        """Round program threading mutable collections (BatchNorm stats)
+        through local training and the aggregation."""
+        opt = self._opt
+        loss_fn = self._loss_fn
+        module = self.module
+        aux_mode = self.aux_mode
+
+        def local_train(params, aux, xb, yb, epochs):
+            opt_state = opt.init(params)
+
+            def batch_step(carry, batch):
+                p, o, a = carry
+                x, y = batch
+
+                def loss_of(pp):
+                    logits, new_a = module.apply(
+                        {"params": pp, **a}, x, train=True, mutable=list(a)
+                    )
+                    return loss_fn(logits, y).mean(), new_a
+
+                (loss, new_a), grads = jax.value_and_grad(
+                    loss_of, has_aux=True
+                )(p)
+                updates, o = opt.update(grads, o, p)
+                p = optax.apply_updates(p, updates)
+                return (p, o, new_a), loss
+
+            def epoch_body(_, carry):
+                carry, _losses = jax.lax.scan(batch_step, carry, (xb, yb))
+                return carry
+
+            params, opt_state, aux = jax.lax.fori_loop(
+                0, epochs, epoch_body, (params, opt_state, aux)
+            )
+            logits = module.apply({"params": params, **aux}, xb[0], train=False)
+            return params, aux, loss_fn(logits, yb[0]).mean()
+
+        def round_impl(params, aux, xs, ys, weights, epochs=1):
+            trained, new_aux, losses = jax.vmap(
+                lambda p, a, x, y: local_train(p, a, x, y, epochs)
+            )(params, aux, xs, ys)
+            out_params = _diffuse(trained, weights)
+            if aux_mode == "local":
+                # FedBN: stats stay per-node — but a w=0 node did not
+                # participate in the round, so its private stats must
+                # not advance (mirror the params mask).
+                def keep_old(new, old):
+                    sel = weights.reshape(
+                        (-1,) + (1,) * (new.ndim - 1)
+                    ) > 0
+                    return jnp.where(sel, new, old)
+
+                out_aux = jax.tree_util.tree_map(keep_old, new_aux, aux)
+            else:
+                # "mean": one global set of stats rides with the model.
+                out_aux = _diffuse(new_aux, weights)
+            return out_params, out_aux, losses
+
+        if self.mesh is None:
+            return jax.jit(round_impl, static_argnums=(5,), donate_argnums=(0, 1))
+        sharding = federation_sharding(self.mesh)
+        return jax.jit(
+            round_impl,
+            static_argnums=(5,),
+            donate_argnums=(0, 1),
+            in_shardings=(
+                sharding,
+                sharding,
+                sharding,
+                sharding,
+                replicated(self.mesh),
+            ),
+            out_shardings=(sharding, sharding, sharding),
+        )
+
     def round(
         self,
         params: Any,
@@ -181,30 +287,39 @@ class VmapFederation:
         ys: Any,
         weights: Optional[Any] = None,
         epochs: int = 1,
-    ) -> tuple[Any, Any]:
-        """Run one federated round; returns (new stacked params, per-node
-        losses). ``weights`` [N]: FedAvg weight per node (0 = not in the
-        round's train set); default = uniform full participation."""
-        if self._round_fn is None:
-            self._round_fn = self._build_round()
+        aux: Optional[Any] = None,
+    ) -> tuple[Any, ...]:
+        """Run one federated round. ``weights`` [N]: FedAvg weight per
+        node (0 = not in the round's train set); default = uniform full
+        participation.
+
+        Returns ``(new stacked params, per-node losses)``; with ``aux``
+        (node-stacked mutable collections from :meth:`init_state`)
+        returns ``(params, aux, losses)`` — stats trained with
+        ``train=True`` and aggregated per :attr:`aux_mode`."""
         if weights is None:
             weights = jnp.ones((self.n_nodes,), jnp.float32)
-        return self._round_fn(
-            params, xs, ys, jnp.asarray(weights, jnp.float32), epochs
-        )
+        weights = jnp.asarray(weights, jnp.float32)
+        if aux:
+            if self._round_aux_fn is None:
+                self._round_aux_fn = self._build_round_aux()
+            return self._round_aux_fn(params, aux, xs, ys, weights, epochs)
+        if self._round_fn is None:
+            self._round_fn = self._build_round()
+        return self._round_fn(params, xs, ys, weights, epochs)
 
     # --- evaluation ---
 
-    def _build_eval(self) -> Callable:
+    def _build_eval(self, with_aux: bool) -> Callable:
         module = self.module
         loss_fn = self._loss_fn
 
         @jax.jit
-        def eval_fn(params, xs, ys):
-            def one_node(p, xb, yb):
+        def eval_fn(params, aux, xs, ys):
+            def one_node(p, a, xb, yb):
                 def one_batch(carry, batch):
                     x, y = batch
-                    logits = module.apply({"params": p}, x, train=False)
+                    logits = module.apply({"params": p, **a}, x, train=False)
                     loss = loss_fn(logits, y).mean()
                     acc = jnp.mean(jnp.argmax(logits, -1) == y)
                     return carry, (loss, acc)
@@ -212,12 +327,20 @@ class VmapFederation:
                 _, (losses, accs) = jax.lax.scan(one_batch, 0.0, (xb, yb))
                 return jnp.mean(losses), jnp.mean(accs)
 
-            return jax.vmap(one_node)(params, xs, ys)
+            return jax.vmap(one_node)(params, aux, xs, ys)
 
-        return eval_fn
+        if with_aux:
+            return eval_fn
+        return jax.jit(lambda params, xs, ys: eval_fn(params, {}, xs, ys))
 
-    def evaluate(self, params: Any, xs: Any, ys: Any) -> tuple[Any, Any]:
+    def evaluate(
+        self, params: Any, xs: Any, ys: Any, aux: Optional[Any] = None
+    ) -> tuple[Any, Any]:
         """Per-node (loss, accuracy) over node-stacked eval data."""
+        if aux:
+            if self._eval_aux_fn is None:
+                self._eval_aux_fn = self._build_eval(with_aux=True)
+            return self._eval_aux_fn(params, aux, xs, ys)
         if self._eval_fn is None:
-            self._eval_fn = self._build_eval()
+            self._eval_fn = self._build_eval(with_aux=False)
         return self._eval_fn(params, xs, ys)

@@ -66,6 +66,7 @@ class ShardedTrainer:
         self._loss_fn = loss_fn or cross_entropy_loss
         self.seed = seed
         self._step_fn: Optional[Callable] = None
+        self._step_aux_fn: Optional[Callable] = None
 
     # --- setup ---
 
@@ -83,22 +84,35 @@ class ShardedTrainer:
         )
 
     def init(self, input_shape: tuple[int, ...]) -> tuple[Any, Any]:
-        """(params, opt_state), placed on the mesh."""
+        """(params, opt_state), placed on the mesh (aux-free modules;
+        BatchNorm'd models use :meth:`init_with_aux`)."""
+        params, aux, opt_state = self.init_with_aux(input_shape)
+        if aux:
+            raise ValueError(
+                f"Module has mutable collections {sorted(aux)} — use "
+                f"init_with_aux() and train_step_with_aux()."
+            )
+        return params, opt_state
+
+    def init_with_aux(self, input_shape: tuple[int, ...]) -> tuple[Any, Any, Any]:
+        """(params, aux, opt_state), placed on the mesh. ``aux`` holds
+        mutable collections (``batch_stats`` for BatchNorm models like
+        ResNet18), replicated across the mesh — stats are small and are
+        updated by the same replicated computation on every shard."""
         dummy = jnp.zeros((1, *input_shape), jnp.float32)
         variables = self.module.init(
             jax.random.PRNGKey(self.seed), dummy, train=False
         )
-        extra = [k for k in variables if k != "params"]
-        if extra:
-            raise NotImplementedError(
-                f"ShardedTrainer does not yet thread mutable collections "
-                f"{extra} (e.g. BatchNorm stats); use JaxLearner for such "
-                f"models."
-            )
         params = variables["params"]
+        aux = {k: v for k, v in variables.items() if k != "params"}
         params = jax.device_put(params, self._param_sharding(params))
+        if aux:
+            rep = NamedSharding(self.mesh, PartitionSpec())
+            aux = jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, rep), aux
+            )
         opt_state = self._opt.init(params)
-        return params, opt_state
+        return params, aux, opt_state
 
     def shard_batch(self, x: Any, y: Any) -> tuple[Any, Any]:
         """Shard the batch dimension over dp."""
@@ -147,3 +161,48 @@ class ShardedTrainer:
         if self._step_fn is None:
             self._step_fn = self._build_step(params)
         return self._step_fn(params, opt_state, x, y)
+
+    # --- aux-threaded variant (BatchNorm models) ---
+
+    def _build_step_aux(self, params: Any) -> Callable:
+        module = self.module
+        loss_fn = self._loss_fn
+        opt = self._opt
+        param_sh = self._param_sharding(params)
+        batch_sh = NamedSharding(self.mesh, PartitionSpec(self.axis))
+        rep = NamedSharding(self.mesh, PartitionSpec())
+
+        def step(params, aux, opt_state, x, y):
+            def loss_of(p):
+                logits, new_aux = module.apply(
+                    {"params": p, **aux}, x, train=True, mutable=list(aux)
+                )
+                return loss_fn(logits, y).mean(), new_aux
+
+            (loss, new_aux), grads = jax.value_and_grad(
+                loss_of, has_aux=True
+            )(params)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, new_aux, opt_state, loss
+
+        del rep  # aux arrives replicated; jit keeps the layout
+        return jax.jit(
+            step,
+            donate_argnums=(0, 1, 2),
+            in_shardings=(param_sh, None, None, batch_sh, batch_sh),
+            out_shardings=None,
+        )
+
+    def train_step_with_aux(
+        self, params: Any, aux: Any, opt_state: Any, x: Any, y: Any
+    ) -> tuple[Any, Any, Any, Any]:
+        """One dp/FSDP step threading mutable collections: returns
+        (params, aux, opt_state, loss). BatchNorm runs with
+        ``train=True`` on the *logical* (whole) batch: under jit the
+        sharded batch is one logical array, so XLA computes the global
+        batch mean/var with cross-shard collectives — sync-BN semantics
+        for free, and the updated stats stay replicated."""
+        if self._step_aux_fn is None:
+            self._step_aux_fn = self._build_step_aux(params)
+        return self._step_aux_fn(params, aux, opt_state, x, y)
