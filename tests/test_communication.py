@@ -231,3 +231,61 @@ def test_message_wire_roundtrip():
     assert m2.source == "a" and m2.cmd == "model" and m2.round == 2
     assert m2.payload == b"\x00\x01" and m2.contributors == ["a", "b"]
     assert m2.msg_hash == m.msg_hash and m2.ttl == 3 and m2.num_samples == 5
+
+
+# --- mTLS (reference gen-certs.sh + CI's SSL test settings) ---------------
+
+
+def test_mtls_handshake_and_send(tmp_path):
+    """Full mutual-TLS loopback: cert generation (gen-certs.sh port),
+    secure server + secure channel, handshake, message delivery."""
+    from tpfl.settings import Settings
+    from tpfl.utils.certificates import enable_mtls
+
+    enable_mtls(str(tmp_path))
+    assert Settings.USE_SSL
+    got = []
+    a, b = make_nodes(GrpcCommunicationProtocol, 2)
+    try:
+        a.add_command("ping", lambda source, round, **kw: got.append(source))
+        assert b.connect(a.get_address())
+        assert b.get_address() in a.get_neighbors(only_direct=True)
+        b.send(a.get_address(), b.build_msg("ping"))
+        deadline = time.time() + 10
+        while not got and time.time() < deadline:
+            time.sleep(0.05)
+        assert got == [b.get_address()]
+    finally:
+        stop_all([a, b])
+
+
+def test_mtls_rejects_unauthenticated_client(tmp_path):
+    """A TLS client presenting no client certificate must be rejected
+    (require_client_auth=True) — this is the mutual part of mTLS; a
+    plaintext dial failing would not prove it."""
+    import grpc
+
+    from tpfl.settings import Settings
+    from tpfl.utils.certificates import enable_mtls
+
+    enable_mtls(str(tmp_path))
+    server = make_nodes(GrpcCommunicationProtocol, 1)[0]
+    try:
+        with open(Settings.CA_CRT, "rb") as f:
+            ca = f.read()
+        # Trusts the server's CA but presents NO client cert.
+        channel = grpc.secure_channel(
+            server.get_address(), grpc.ssl_channel_credentials(root_certificates=ca)
+        )
+        import msgpack
+
+        stub = channel.unary_unary(
+            "/tpfl.NodeServices/Handshake",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
+        )
+        with pytest.raises(grpc.RpcError):
+            stub(msgpack.packb({"addr": "mallory"}), timeout=5)
+        channel.close()
+    finally:
+        stop_all([server])

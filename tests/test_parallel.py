@@ -271,3 +271,19 @@ def test_fedbn_mask_keeps_nonparticipant_stats():
         np.testing.assert_array_equal(new[2:], old[2:])
         # Participants: stats moved.
         assert np.abs(new[:2] - old[:2]).max() > 0
+
+
+def test_round_uniform_api_with_empty_aux():
+    """init_state -> round(aux=...) works for aux-free modules too
+    (aux={} still takes the 3-tuple path)."""
+    import jax.numpy as jnp2
+
+    n = 2
+    fed = VmapFederation(MLP(hidden_sizes=(16,), compute_dtype=jnp.float32), n)
+    params, aux = fed.init_state((28, 28))
+    assert aux == {}
+    xs, ys = _node_data(n, n_batches=2, bs=8)
+    p2, a2, losses = fed.round(params, jnp.asarray(xs), jnp.asarray(ys), aux=aux)
+    assert a2 == {} and losses.shape == (n,)
+    loss_e, acc_e = fed.evaluate(p2, jnp.asarray(xs), jnp.asarray(ys), aux=a2)
+    assert np.all(np.isfinite(np.asarray(loss_e)))

@@ -294,13 +294,14 @@ class VmapFederation:
         participation.
 
         Returns ``(new stacked params, per-node losses)``; with ``aux``
-        (node-stacked mutable collections from :meth:`init_state`)
-        returns ``(params, aux, losses)`` — stats trained with
-        ``train=True`` and aggregated per :attr:`aux_mode`."""
+        not None (mutable collections from :meth:`init_state` — possibly
+        ``{}`` for aux-free modules, the API stays uniform) returns
+        ``(params, aux, losses)`` — stats trained with ``train=True``
+        and aggregated per :attr:`aux_mode`."""
         if weights is None:
             weights = jnp.ones((self.n_nodes,), jnp.float32)
         weights = jnp.asarray(weights, jnp.float32)
-        if aux:
+        if aux is not None:
             if self._round_aux_fn is None:
                 self._round_aux_fn = self._build_round_aux()
             return self._round_aux_fn(params, aux, xs, ys, weights, epochs)
@@ -337,7 +338,7 @@ class VmapFederation:
         self, params: Any, xs: Any, ys: Any, aux: Optional[Any] = None
     ) -> tuple[Any, Any]:
         """Per-node (loss, accuracy) over node-stacked eval data."""
-        if aux:
+        if aux is not None:
             if self._eval_aux_fn is None:
                 self._eval_aux_fn = self._build_eval(with_aux=True)
             return self._eval_aux_fn(params, aux, xs, ys)

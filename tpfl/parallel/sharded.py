@@ -170,7 +170,6 @@ class ShardedTrainer:
         opt = self._opt
         param_sh = self._param_sharding(params)
         batch_sh = NamedSharding(self.mesh, PartitionSpec(self.axis))
-        rep = NamedSharding(self.mesh, PartitionSpec())
 
         def step(params, aux, opt_state, x, y):
             def loss_of(p):
@@ -186,10 +185,11 @@ class ShardedTrainer:
             params = optax.apply_updates(params, updates)
             return params, new_aux, opt_state, loss
 
-        del rep  # aux arrives replicated; jit keeps the layout
         return jax.jit(
             step,
             donate_argnums=(0, 1, 2),
+            # aux/opt-state shardings None: they arrive replicated from
+            # init_with_aux and jit keeps the layout.
             in_shardings=(param_sh, None, None, batch_sh, batch_sh),
             out_shardings=None,
         )

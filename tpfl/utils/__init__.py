@@ -1,5 +1,6 @@
 """User-facing utilities: topologies, convergence waits, model checks."""
 
+from tpfl.utils.certificates import enable_mtls, generate_certificates
 from tpfl.utils.topologies import TopologyFactory, TopologyType
 from tpfl.utils.utils import (
     check_equal_models,
@@ -15,4 +16,6 @@ __all__ = [
     "wait_to_finish",
     "full_connection",
     "check_equal_models",
+    "generate_certificates",
+    "enable_mtls",
 ]
