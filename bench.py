@@ -1,18 +1,24 @@
-"""Benchmark: FedAvg rounds/sec + samples/sec/chip, CIFAR-10 CNN, 100 nodes.
+"""Benchmark: FedAvg rounds/sec + samples/sec/chip + MFU on real images.
 
 The driver-defined north-star (BASELINE.json): a 100-node FedAvg CIFAR-10
 federation. The reference (p2pfl) runs each node as a Ray-actor process
 with pickled-numpy weight exchange and publishes no numbers; its
 implicit envelope is the test/example budget (2-node 2-round MNIST in
-≤ 240 s, examples ≤ 3600 s — BASELINE.md). Here one full federated
-round (100 nodes × 1 local epoch + exact FedAvg) is a single XLA
+<= 240 s, examples <= 3600 s — BASELINE.md). Here one full federated
+round (100 nodes x 1 local epoch + exact FedAvg) is a single XLA
 program on one chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-``value`` = local-epoch samples/sec/chip across the federation;
-``vs_baseline`` = measured rounds/sec over the reference envelope's
-implied floor (2 rounds / 240 s = 0.00833 rounds/s, the only
-quantitative anchor the reference provides).
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
+- value: local-epoch samples/sec/chip across the federation, measured on
+  RENDERED DIGIT IMAGES (real vision data, rendered.py — not noise).
+- vs_baseline: measured rounds/sec over the reference envelope's floor
+  (2 rounds / 240 s, the only quantitative anchor the reference gives).
+- extra.mfu: model FLOPs utilization — XLA's own cost analysis of the
+  compiled round program over the chip's peak bf16 FLOP/s.
+- extra.resnet18_*: BASELINE config 3 tier (ResNet-18 w/ BatchNorm via
+  the aux-threaded vmapped path, CIFAR-100-shaped).
+- extra.sim1000_*: BASELINE config 4 tier (1000 nodes, 10% partial
+  participation per round, masked vmapped federation).
 """
 
 from __future__ import annotations
@@ -20,22 +26,76 @@ from __future__ import annotations
 import json
 import time
 
+# Peak dense bf16 FLOP/s per chip by device kind (public specs).
+_PEAK_FLOPS = {
+    "TPU v5 lite": 197e12,  # v5e
+    "TPU v5e": 197e12,
+    "TPU v4": 275e12,
+    "TPU v6 lite": 918e12,  # v6e / Trillium
+}
+
+
+def _peak_flops(device) -> float | None:
+    kind = getattr(device, "device_kind", "") or ""
+    for k, v in _PEAK_FLOPS.items():
+        if kind.startswith(k):
+            return v
+    return None
+
+
+def _round_flops(fed, params, xs, ys, epochs, aux=None) -> float | None:
+    """XLA's flop count for the compiled round program."""
+    try:
+        import jax.numpy as jnp
+
+        weights = jnp.ones((fed.n_nodes,), jnp.float32)
+        if aux is not None:
+            lowered = fed._round_aux_fn.lower(params, aux, xs, ys, weights, epochs)
+        else:
+            lowered = fed._round_fn.lower(params, xs, ys, weights, epochs)
+        cost = lowered.compile().cost_analysis()
+        if isinstance(cost, list):  # older jax returns [dict]
+            cost = cost[0]
+        return float(cost.get("flops", 0.0)) or None
+    except Exception:
+        return None
+
+
+def _time_rounds(fed, params, xs, ys, epochs, n_rounds, aux=None, weights=None):
+    """Warmup + timed rounds; returns (rounds/sec, final params)."""
+    import numpy as np
+
+    def one(p, a):
+        if a is not None:
+            p, a, losses = fed.round(p, xs, ys, weights=weights, epochs=epochs, aux=a)
+        else:
+            p, losses = fed.round(p, xs, ys, weights=weights, epochs=epochs)
+        return p, a, losses
+
+    params, aux, losses = one(params, aux)  # compile
+    float(np.asarray(losses).mean())  # sync (block_until_ready unreliable here)
+    t0 = time.perf_counter()
+    for _ in range(n_rounds):
+        params, aux, losses = one(params, aux)
+    float(np.asarray(losses).mean())
+    return n_rounds / (time.perf_counter() - t0), params
+
 
 def main() -> None:
     import jax
     import jax.numpy as jnp
     import numpy as np
 
-    from tpfl.models import CNN
+    from tpfl.learning.dataset.rendered import rendered_color_digits
+    from tpfl.models import CNN, MLP, ResNet18
     from tpfl.parallel import VmapFederation
 
     n_chips = len(jax.devices())
-    # Node count must divide over the mesh; 100 on one chip (the
-    # BASELINE.json config), nearest multiple on a multi-chip host.
+    extra: dict = {"chips": n_chips, "real_image_data": True}
+
+    # ---- primary: 100-node CNN on rendered color digits (config 2) ----
     n_nodes = 100 if n_chips == 1 else (100 // n_chips) * n_chips
-    n_batches = 4
-    batch_size = 32
-    epochs = 1
+    n_batches, batch_size, epochs = 4, 32, 1
     samples_per_round = n_nodes * n_batches * batch_size * epochs
 
     mesh = None
@@ -47,27 +107,66 @@ def main() -> None:
         CNN(out_channels=10), n_nodes=n_nodes, mesh=mesh, learning_rate=0.1, seed=0
     )
     params = fed.init_params((32, 32, 3))
-    rng = np.random.default_rng(0)
-    xs = rng.normal(0.5, 0.25, size=(n_nodes, n_batches, batch_size, 32, 32, 3)).astype(
-        np.float32
-    )
-    ys = rng.integers(0, 10, size=(n_nodes, n_batches, batch_size)).astype(np.int32)
+    per_node = n_batches * batch_size
+    ds = rendered_color_digits(n_train=n_nodes * per_node, n_test=10, seed=0)
+    x_all = np.asarray(ds.get_split(True)["image"], np.float32)
+    y_all = np.asarray(ds.get_split(True)["label"], np.int32)
+    xs = x_all.reshape(n_nodes, n_batches, batch_size, 32, 32, 3)
+    ys = y_all.reshape(n_nodes, n_batches, batch_size)
     xs, ys = fed.shard_data(xs, ys)
 
-    # Warmup/compile (host readback = unambiguous sync point; on this
-    # platform block_until_ready has been observed returning early).
-    params, losses = fed.round(params, xs, ys, epochs=epochs)
-    float(np.asarray(losses).mean())
-
-    n_rounds = 10
-    t0 = time.perf_counter()
-    for _ in range(n_rounds):
-        params, losses = fed.round(params, xs, ys, epochs=epochs)
-    float(np.asarray(losses).mean())  # sync
-    dt = time.perf_counter() - t0
-
-    rounds_per_sec = n_rounds / dt
+    rounds_per_sec, params = _time_rounds(fed, params, xs, ys, epochs, n_rounds=10)
     samples_per_sec_chip = rounds_per_sec * samples_per_round / n_chips
+
+    flops = _round_flops(fed, params, xs, ys, epochs)
+    peak = _peak_flops(jax.devices()[0])
+    if flops and peak:
+        if mesh is not None:
+            # cost_analysis reports per-device flops for SPMD programs;
+            # scale to the whole round.
+            flops *= n_chips
+        extra["round_tflops"] = round(flops / 1e12, 3)
+        extra["mfu"] = round(rounds_per_sec * flops / (peak * n_chips), 4)
+
+    # ---- config 3 tier: ResNet-18 (BatchNorm aux path), CIFAR-100 ----
+    try:
+        n3, nb3, bs3 = 16, 2, 32
+        fed3 = VmapFederation(
+            ResNet18(out_channels=100), n_nodes=n3, learning_rate=0.1, seed=0
+        )
+        p3, a3 = fed3.init_state((32, 32, 3))
+        xs3 = x_all[: n3 * nb3 * bs3].reshape(n3, nb3, bs3, 32, 32, 3)
+        ys3 = y_all[: n3 * nb3 * bs3].reshape(n3, nb3, bs3)
+        rps3, _ = _time_rounds(
+            fed3, p3, jnp.asarray(xs3), jnp.asarray(ys3), 1, n_rounds=3, aux=a3
+        )
+        extra["resnet18_cfg3_nodes"] = n3
+        # fed3 runs mesh-less on ONE device — that device's throughput
+        # IS the per-chip number regardless of host chip count.
+        extra["resnet18_cfg3_samples_per_sec_chip"] = round(
+            rps3 * n3 * nb3 * bs3, 1
+        )
+    except Exception as e:  # keep the primary metric alive
+        extra["resnet18_cfg3_error"] = str(e)[:200]
+
+    # ---- config 4 tier: 1000 nodes, 10% partial participation ----
+    try:
+        n4, nb4, bs4 = 1000, 1, 32
+        fed4 = VmapFederation(
+            MLP(hidden_sizes=(64,)), n_nodes=n4, learning_rate=0.1, seed=0
+        )
+        p4 = fed4.init_params((28, 28))
+        rng = np.random.default_rng(0)
+        xs4 = rng.random((n4, nb4, bs4, 28, 28), np.float32)
+        ys4 = rng.integers(0, 10, (n4, nb4, bs4)).astype(np.int32)
+        w4 = (rng.random(n4) < 0.1).astype(np.float32)  # ~100 elected/round
+        rps4, _ = _time_rounds(
+            fed4, p4, jnp.asarray(xs4), jnp.asarray(ys4), 1, n_rounds=5,
+            weights=jnp.asarray(w4),
+        )
+        extra["sim1000_partial_rounds_per_sec"] = round(rps4, 2)
+    except Exception as e:
+        extra["sim1000_error"] = str(e)[:200]
 
     # Only quantitative anchor in the reference: 2-round MNIST e2e must
     # fit in 240 s (node_test.py:105) -> 0.00833 rounds/s floor.
@@ -82,6 +181,7 @@ def main() -> None:
                 "vs_baseline": round(
                     rounds_per_sec / reference_floor_rounds_per_sec, 1
                 ),
+                "extra": extra,
             }
         )
     )
