@@ -186,15 +186,16 @@ def test_scaffold_e2e():
     from tpfl.learning.aggregators import Scaffold
 
     n, rounds = 4, 2
-    # noise=0.3: the accuracy gate must clear regardless of which node
-    # addresses (and hence per-node shuffle seeds) the suite has already
-    # consumed when this test runs.
     ds = synthetic_mnist(n_train=200 * n, n_test=40 * n, seed=0, noise=0.3)
     parts = ds.generate_partitions(n, RandomIIDPartitionStrategy, seed=1)
     nodes = [
         Node(
             create_model("mlp", (28, 28), seed=7, hidden_sizes=(32,)),
             parts[i],
+            # Pinned addresses: per-node shuffle seeds derive from the
+            # address, so the accuracy gate must not depend on how many
+            # auto-numbered nodes earlier tests created.
+            addr=f"scaffold-e2e-{i}",
             aggregator=Scaffold(),
             learning_rate=0.1,
             batch_size=32,
@@ -232,6 +233,7 @@ def test_fedprox_e2e():
         Node(
             create_model("mlp", (28, 28), seed=7, hidden_sizes=(32,)),
             parts[i],
+            addr=f"fedprox-e2e-{i}",
             aggregator=FedProx(proximal_mu=0.05),
             learning_rate=0.1,
             batch_size=32,
@@ -330,6 +332,7 @@ def test_accuracy_contract_on_rendered_images():
         Node(
             create_model("mlp", (28, 28), seed=7, hidden_sizes=(64,)),
             parts[i],
+            addr=f"rendered-e2e-{i}",
             learning_rate=0.1,
             batch_size=50,
         )

@@ -200,6 +200,7 @@ class ThreadedCommunicationProtocol(CommunicationProtocol):
                 logger.debug(self._addr, f"Dial {nei} failed: {e}")
                 return
         try:
+            msg.via = self._addr  # mark the hop (flood skip-back)
             self._transport_send(nei, conn, msg)
         except Exception as e:
             # On-send-error eviction (reference grpc_client.py:176-183).
@@ -310,5 +311,8 @@ class ThreadedCommunicationProtocol(CommunicationProtocol):
                     args=msg.args,
                     ttl=msg.ttl - 1,
                     msg_hash=msg.msg_hash,
+                    # Preserve the hop we received from, so the re-flood
+                    # skips echoing straight back at it.
+                    via=msg.via,
                 )
             )

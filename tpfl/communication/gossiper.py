@@ -43,9 +43,12 @@ class Gossiper(threading.Thread):
         self._get_neighbors = get_neighbors_fn
         self._pending: deque[Message] = deque()
         self._pending_lock = threading.Lock()
-        self._processed: deque[str] = deque(
-            maxlen=Settings.AMOUNT_LAST_MESSAGES_SAVED
-        )
+        # FIFO eviction ring + set: membership must be O(1) — a plain
+        # deque scan is O(AMOUNT_LAST_MESSAGES_SAVED) per message and
+        # melts the relay hub of a star topology at scale (every vote /
+        # status broadcast crosses it twice).
+        self._processed_ring: deque[str] = deque()
+        self._processed_set: set[str] = set()
         self._processed_lock = threading.Lock()
         self._stop_event = threading.Event()
         seed = (Settings.SEED or 0) + zlib.crc32(self_addr.encode())
@@ -58,9 +61,12 @@ class Gossiper(threading.Thread):
         if not msg_hash:
             return True
         with self._processed_lock:
-            if msg_hash in self._processed:
+            if msg_hash in self._processed_set:
                 return False
-            self._processed.append(msg_hash)
+            self._processed_set.add(msg_hash)
+            self._processed_ring.append(msg_hash)
+            while len(self._processed_ring) > Settings.AMOUNT_LAST_MESSAGES_SAVED:
+                self._processed_set.discard(self._processed_ring.popleft())
             return True
 
     # --- async message flood (reference gossiper.py:124-157) ---
@@ -77,9 +83,20 @@ class Gossiper(threading.Thread):
                     min(len(self._pending), Settings.GOSSIP_MESSAGES_PER_PERIOD)
                 ):
                     batch.append(self._pending.popleft())
+            if batch:
+                # One snapshot per batch: get_neighbors copies the table,
+                # and a relay hub forwards thousands of messages per
+                # round — per-message copies dominate otherwise.
+                neighbors = list(self._get_neighbors(True))
             for msg in batch:
-                for nei in self._get_neighbors(True):
-                    if nei != msg.source:
+                # Capture before sending: the transport overwrites
+                # msg.via with our own address at dispatch time.
+                # Skipping the originator AND the hop that delivered it
+                # to us — in a star topology the echo back to the hub is
+                # half of all flood traffic.
+                skip = {msg.source, msg.via}
+                for nei in neighbors:
+                    if nei not in skip:
                         try:
                             self._send(nei, msg)
                         except Exception as e:

@@ -38,6 +38,11 @@ class Message:
     payload: Optional[bytes] = None
     contributors: list[str] = field(default_factory=list)
     num_samples: int = 0
+    # Immediate relayer (≠ source once forwarded): lets the TTL flood
+    # skip the hop it came from — in a star topology half of all flood
+    # traffic is otherwise leaves echoing messages straight back at the
+    # hub. Set by the transport at send time.
+    via: str = ""
 
     @property
     def is_weights(self) -> bool:
@@ -64,6 +69,7 @@ class Message:
                 "w": self.payload,
                 "c": self.contributors,
                 "n": self.num_samples,
+                "v": self.via,
             },
             use_bin_type=True,
         )
@@ -81,4 +87,5 @@ class Message:
             payload=d["w"],
             contributors=list(d["c"]),
             num_samples=d["n"],
+            via=d.get("v", ""),
         )
