@@ -105,3 +105,31 @@ def test_apply_to_params_sign_flip():
     m.apply_to_params(lambda x: -x)
     after = m.get_parameters_list()
     np.testing.assert_allclose(after[0], -before[0])
+
+
+def test_wire_dtype_compression_roundtrip():
+    """Settings.WIRE_DTYPE='bfloat16' halves float32 wire bytes; the
+    receiver restores its own dtypes (multi-host DCN gossip saving)."""
+    from tpfl.settings import Settings
+
+    rng = np.random.default_rng(0)
+    big = {"w": jnp.asarray(rng.normal(size=(128, 128)), jnp.float32)}
+    m = TpflModel(params=big)
+    exact = m.encode_parameters()
+    prev = Settings.WIRE_DTYPE
+    Settings.WIRE_DTYPE = "bfloat16"
+    try:
+        compressed = m.encode_parameters()
+        assert len(compressed) < 0.55 * len(exact)
+        recv = TpflModel(
+            params={"w": jnp.zeros((128, 128), jnp.float32)}
+        )
+        recv.set_parameters(compressed)
+        for got, want in zip(
+            recv.get_parameters_list(), m.get_parameters_list()
+        ):
+            got = np.asarray(got)
+            assert got.dtype == np.asarray(want).dtype  # dtype restored
+            np.testing.assert_allclose(got, np.asarray(want), rtol=1e-2, atol=1e-2)
+    finally:
+        Settings.WIRE_DTYPE = prev

@@ -352,3 +352,27 @@ def test_accuracy_contract_on_rendered_images():
     finally:
         for nd in nodes:
             nd.stop()
+
+
+def test_convergence_with_bf16_wire():
+    """Full protocol run with bfloat16 wire compression: model gossip
+    halves its bytes and the federation still converges + agrees."""
+    Settings.WIRE_DTYPE = "bfloat16"
+    nodes = build_nodes(2)
+    try:
+        nodes[0].connect(nodes[1].addr)
+        wait_convergence(nodes, 1, wait=10)
+        nodes[0].set_start_learning(rounds=2, epochs=2)
+        wait_to_finish(nodes, timeout=180)
+        # bf16 wire: agreement within bf16 resolution, not exact.
+        a, b = (
+            [np.asarray(x) for x in nd.learner.get_model().get_parameters_list()]
+            for nd in nodes
+        )
+        for x, y in zip(a, b):
+            np.testing.assert_allclose(x, y, rtol=1e-2, atol=1e-2)
+        accs = [nd.learner.evaluate()["test_metric"] for nd in nodes]
+        assert all(acc > 0.5 for acc in accs), accs
+    finally:
+        for nd in nodes:
+            nd.stop()

@@ -287,3 +287,47 @@ def test_round_uniform_api_with_empty_aux():
     assert a2 == {} and losses.shape == (n,)
     loss_e, acc_e = fed.evaluate(p2, jnp.asarray(xs), jnp.asarray(ys), aux=a2)
     assert np.all(np.isfinite(np.asarray(loss_e)))
+
+
+def test_federation_learner_hierarchical():
+    """BASELINE config 5 shape: 2 protocol 'hosts' x 4 local vmapped
+    nodes each — the outer gossip protocol runs 2 nodes while 8 logical
+    nodes train; hosts converge and agree."""
+    from tpfl.communication.memory import clear_registry
+    from tpfl.learning.dataset import synthetic_mnist
+    from tpfl.models import create_model
+    from tpfl.node import Node
+    from tpfl.parallel import FederationLearner
+    from tpfl.utils import check_equal_models, wait_convergence, wait_to_finish
+
+    clear_registry()
+    ds = synthetic_mnist(n_train=1600, n_test=320, seed=0, noise=0.4)
+    shards = ds.generate_partitions(2, RandomIIDPartitionStrategy, seed=0)
+    nodes = []
+    for i in range(2):
+        model = create_model("mlp", (28, 28), seed=7, hidden_sizes=(32,))
+        learner = FederationLearner(
+            n_local_nodes=4,
+            local_rounds=2,
+            learning_rate=0.1,
+            batch_size=25,
+            seed=i,
+        )
+        nodes.append(
+            Node(model, shards[i], addr=f"slice-{i}", learner=learner)
+        )
+    for nd in nodes:
+        nd.start()
+    try:
+        nodes[0].connect(nodes[1].addr)
+        wait_convergence(nodes, 1, wait=10)
+        nodes[0].set_start_learning(rounds=2, epochs=1)
+        wait_to_finish(nodes, timeout=240)
+        check_equal_models(nodes)
+        # 8 logical nodes trained; outer protocol only saw 2.
+        m = nodes[0].learner.evaluate()
+        assert m["test_metric"] > 0.5, m
+    finally:
+        for nd in nodes:
+            nd.stop()
+        clear_registry()

@@ -1,0 +1,75 @@
+"""Multi-host / multi-slice deployment — BASELINE config 5.
+
+One process per TPU host/slice. Each process is ONE protocol Node whose
+learner is a :class:`tpfl.parallel.FederationLearner`: its "local fit"
+trains ``--local-nodes`` logical FL nodes as a single vmapped XLA
+program (collectives over ICI), and only the slice-level aggregate
+crosses hosts over gRPC/DCN. Gossip traffic is O(hosts), not O(logical
+nodes).
+
+Terminal 1 (passive slice):   python -m tpfl.examples.multislice --port 6700
+Terminal 2 (driving slice):   python -m tpfl.examples.multislice \
+    --port 6701 --connect-to 127.0.0.1:6700 --rounds 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+from tpfl.communication.grpc_transport import GrpcCommunicationProtocol
+from tpfl.learning.dataset import rendered_digits
+from tpfl.models import create_model
+from tpfl.node import Node
+from tpfl.parallel import FederationLearner
+from tpfl.settings import Settings
+from tpfl.utils import wait_to_finish
+
+
+def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(description="tpfl multi-slice quickstart.")
+    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--connect-to", type=str, default=None, help="host:port of a running slice (driving role)")
+    p.add_argument("--local-nodes", type=int, default=8)
+    p.add_argument("--local-rounds", type=int, default=1)
+    p.add_argument("--rounds", type=int, default=2)
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--samples", type=int, default=2000)
+    p.add_argument("--seed", type=int, default=666)
+    return p.parse_args(argv)
+
+
+def main(argv: list[str] | None = None) -> None:
+    args = parse_args(argv)
+    Settings.set_standalone_settings()
+    node = Node(
+        create_model("mlp", (28, 28), seed=args.seed),
+        rendered_digits(n_train=args.samples, n_test=400, seed=args.seed + args.port),
+        protocol=GrpcCommunicationProtocol(f"127.0.0.1:{args.port}"),
+        learner=FederationLearner(
+            n_local_nodes=args.local_nodes,
+            local_rounds=args.local_rounds,
+            seed=args.seed,
+        ),
+    )
+    node.start()
+    try:
+        if args.connect_to is None:
+            print(f"Slice listening on {node.addr} ({args.local_nodes} local nodes); Ctrl-C to stop")
+            while True:
+                time.sleep(1)
+        else:
+            if not node.connect(args.connect_to):
+                raise SystemExit(f"Could not connect to {args.connect_to}")
+            time.sleep(2)
+            node.set_start_learning(rounds=args.rounds, epochs=args.epochs)
+            wait_to_finish([node], timeout=3600)
+            print("Slice-level metrics:", node.learner.evaluate())
+    except KeyboardInterrupt:
+        pass
+    finally:
+        node.stop()
+
+
+if __name__ == "__main__":
+    main()

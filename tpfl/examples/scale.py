@@ -53,6 +53,11 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
 def scale(args: argparse.Namespace) -> dict[str, float]:
     Settings.set_scale_settings()
     Settings.TRAIN_SET_SIZE = args.train_set_size
+    # Heartbeat flood costs O(N^2)/period at the relay hub: scale the
+    # beat cadence with the federation size so liveness traffic doesn't
+    # saturate the hub and trigger spurious evictions mid-round.
+    Settings.HEARTBEAT_PERIOD = max(10.0, args.nodes / 25.0)
+    Settings.HEARTBEAT_TIMEOUT = 6.0 * Settings.HEARTBEAT_PERIOD
 
     n = args.nodes
     ds = rendered_digits(
@@ -77,7 +82,9 @@ def scale(args: argparse.Namespace) -> dict[str, float]:
         # 1000 nodes would be ~500k in-process links).
         matrix = TopologyFactory.generate_matrix(TopologyType.STAR, n)
         TopologyFactory.connect_nodes(matrix, nodes)
-        wait_convergence(nodes, n - 1, only_direct=False, wait=120)
+        # Full-view discovery rides the heartbeat flood: every node must
+        # hear N-1 others through the hub, so budget scales with N.
+        wait_convergence(nodes, n - 1, only_direct=False, wait=max(120, n))
         t_ready = time.time()
         print(f"Topology converged in {t_ready - t_start:.1f}s; starting...")
 

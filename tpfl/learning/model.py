@@ -116,13 +116,40 @@ class TpflModel:
                     raise ModelNotMatchingError(
                         f"Shape mismatch: {np.shape(o)} vs {np.shape(n)}"
                     )
+            # Restore this model's own leaf dtypes: wire payloads may
+            # arrive downcast (Settings.WIRE_DTYPE) and the model's
+            # dtype contract must survive the round-trip.
+            treedef = jax.tree_util.tree_structure(self._params)
+            self._params = jax.tree_util.tree_unflatten(
+                treedef,
+                [
+                    jnp.asarray(n, jnp.asarray(o).dtype)
+                    for o, n in zip(old_leaves, new_leaves)
+                ],
+            )
+            return
         self._params = jax.tree_util.tree_map(jnp.asarray, new_params)
 
     # --- serialization (msgpack, not pickle) ---
 
     def encode_parameters(self, params: Optional[Pytree] = None) -> bytes:
+        from tpfl.settings import Settings
+
+        params = params if params is not None else self._params
+        if Settings.WIRE_DTYPE:
+            # Wire compression: downcast float leaves (f32/f64) only;
+            # ints/bools and already-narrow floats pass through. The
+            # receiver's _check_and_set restores its model's dtypes.
+            wire = jnp.dtype(Settings.WIRE_DTYPE)
+            params = jax.tree_util.tree_map(
+                lambda p: p.astype(wire)
+                if jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating)
+                and jnp.asarray(p).dtype.itemsize > wire.itemsize
+                else p,
+                params,
+            )
         return serialization.encode_model_payload(
-            params if params is not None else self._params,
+            params,
             self._contributors,
             self._num_samples,
             self.additional_info,

@@ -18,6 +18,7 @@ round-tripping through pickle on every hop. Here:
 
 from tpfl.parallel.mesh import create_mesh, federation_sharding, replicated
 from tpfl.parallel.federation import VmapFederation
+from tpfl.parallel.federation_learner import FederationLearner
 from tpfl.parallel.sharded import ShardedTrainer
 
 __all__ = [
@@ -25,5 +26,6 @@ __all__ = [
     "federation_sharding",
     "replicated",
     "VmapFederation",
+    "FederationLearner",
     "ShardedTrainer",
 ]

@@ -1,0 +1,194 @@
+"""FederationLearner — one protocol Node wrapping a whole on-chip
+federation (the multi-slice / multi-host design, BASELINE config 5).
+
+The reference deploys one process per FL node and gossips every model
+over the network. On TPU pods the idiomatic layout is hierarchical
+(SURVEY §7 "two planes"): *within* a host/slice, nodes are rows of a
+:class:`~tpfl.parallel.federation.VmapFederation` — local training and
+exact FedAvg are one XLA program, collectives ride ICI; *between* hosts,
+each slice participates in the ordinary gossip protocol as ONE Node
+(votes, heartbeats, model gossip over gRPC/DCN), contributing its
+locally-aggregated model weighted by its total sample count.
+
+A 2-host × 100-local-node deployment therefore runs the wire protocol
+of a 2-node federation while training 200 logical nodes — DCN traffic
+is O(hosts), not O(logical nodes).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpfl.learning.dataset.partition_strategies import RandomIIDPartitionStrategy
+from tpfl.learning.dataset.tpfl_dataset import TpflDataset
+from tpfl.learning.learner import Learner
+from tpfl.learning.model import TpflModel
+from tpfl.parallel.federation import VmapFederation
+
+
+class FederationLearner(Learner):
+    """A Learner whose "local fit" is a whole vmapped sub-federation.
+
+    Args:
+        model: template TpflModel (architecture shared by all local
+            nodes; its params seed the sub-federation each round).
+        data: this host's dataset shard; partitioned across the local
+            nodes on first fit.
+        n_local_nodes: rows of the vmapped federation.
+        local_rounds: sub-federation rounds per outer fit() call (each
+            runs ``self.epochs`` local epochs).
+        mesh: optional Mesh with a ``nodes`` axis for multi-chip hosts.
+        partition_strategy: how to split ``data`` across local nodes.
+    """
+
+    def __init__(
+        self,
+        model: Optional[TpflModel] = None,
+        data: Optional[TpflDataset] = None,
+        addr: str = "unknown-node",
+        aggregator: Optional[Any] = None,
+        n_local_nodes: int = 8,
+        local_rounds: int = 1,
+        mesh: Optional[Any] = None,
+        learning_rate: float = 0.1,
+        batch_size: int = 32,
+        partition_strategy: Any = RandomIIDPartitionStrategy,
+        seed: int = 0,
+    ) -> None:
+        super().__init__(model, data, addr, aggregator)
+        self.n_local_nodes = int(n_local_nodes)
+        self.local_rounds = int(local_rounds)
+        self.mesh = mesh
+        self.learning_rate = float(learning_rate)
+        self.batch_size = int(batch_size)
+        self.partition_strategy = partition_strategy
+        self.seed = int(seed)
+        self._interrupt = threading.Event()
+        self._fed: Optional[VmapFederation] = None
+        self._train_xs: Optional[Any] = None
+        self._train_ys: Optional[Any] = None
+        self._eval_xs: Optional[Any] = None
+        self._eval_ys: Optional[Any] = None
+
+    # --- lazy setup ---
+
+    def set_data(self, data: TpflDataset) -> None:
+        super().set_data(data)
+        self._train_xs = self._eval_xs = None
+
+    def _ensure_fed(self) -> VmapFederation:
+        if self._fed is None:
+            self._fed = VmapFederation(
+                self.get_model().module,
+                self.n_local_nodes,
+                mesh=self.mesh,
+                learning_rate=self.learning_rate,
+                seed=self.seed,
+            )
+        return self._fed
+
+    def _stack_split(self, train: bool) -> tuple[Any, Any]:
+        """Node-stacked [N, n_batches, b, ...] arrays from this host's
+        shard, equal batch counts (truncated to the smallest partition)."""
+        parts = self.get_data().generate_partitions(
+            self.n_local_nodes, self.partition_strategy, seed=self.seed
+        )
+        xs, ys = [], []
+        for p in parts:
+            batches = p.export(batch_size=self.batch_size, train=train)
+            x, y = batches.stacked()
+            xs.append(x)
+            ys.append(y)
+        n_batches = min(x.shape[0] for x in xs)
+        if n_batches == 0:
+            raise ValueError(
+                f"Partitioning {self.get_data().num_samples(train)} samples "
+                f"across {self.n_local_nodes} local nodes left an empty "
+                f"batch set; lower batch_size or n_local_nodes"
+            )
+        xs = np.stack([x[:n_batches] for x in xs])
+        ys = np.stack([y[:n_batches] for y in ys])
+        return self._ensure_fed().shard_data(xs, ys)
+
+    def _train_data(self) -> tuple[Any, Any]:
+        if self._train_xs is None:
+            self._train_xs, self._train_ys = self._stack_split(train=True)
+        return self._train_xs, self._train_ys
+
+    def _eval_data(self) -> tuple[Any, Any]:
+        if self._eval_xs is None:
+            self._eval_xs, self._eval_ys = self._stack_split(train=False)
+        return self._eval_xs, self._eval_ys
+
+    # --- Learner contract ---
+
+    def _stack(self, tree: Any) -> Any:
+        """Broadcast a single model's tree onto the local node axis."""
+        return jax.tree_util.tree_map(
+            lambda p: jnp.broadcast_to(
+                p[None], (self.n_local_nodes, *jnp.shape(p))
+            ),
+            tree,
+        )
+
+    def fit(self) -> TpflModel:
+        self._interrupt.clear()
+        model = self.get_model()
+        fed = self._ensure_fed()
+        xs, ys = self._train_data()
+
+        params = self._stack(model.get_parameters())
+        aux = self._stack(model.aux_state) if model.aux_state else None
+        rounds_run = 0
+        for _ in range(self.local_rounds):
+            if self._interrupt.is_set():
+                break
+            if aux is not None:
+                params, aux, _losses = fed.round(
+                    params, xs, ys, epochs=self.epochs, aux=aux
+                )
+            else:
+                params, _losses = fed.round(params, xs, ys, epochs=self.epochs)
+            rounds_run += 1
+        if rounds_run == 0:
+            return self.skip_fit(model)
+
+        # After diffusion every row holds the slice aggregate: take row 0.
+        agg = jax.tree_util.tree_map(lambda p: p[0], params)
+        model.set_parameters(agg)
+        if aux is not None:
+            model.aux_state = jax.tree_util.tree_map(lambda a: a[0], aux)
+        # Raw shard sample count — matching JaxLearner's convention
+        # (jax_learner.py finish_fit), so mixed federations and slices
+        # with different local_rounds/epochs weight fairly in FedAvg.
+        model.set_contribution([self._addr], self.get_data().num_samples(True))
+        self.add_callback_info_to_model(model)
+        self._last_fit_model = model
+        return model
+
+    def skip_fit(self, model: Optional[TpflModel] = None) -> TpflModel:
+        model = model if model is not None else self.get_model()
+        model.set_contribution([self._addr], 0)
+        self._last_fit_model = model
+        return model
+
+    def interrupt_fit(self) -> None:
+        self._interrupt.set()
+
+    def evaluate(self) -> dict[str, float]:
+        model = self.get_model()
+        fed = self._ensure_fed()
+        xs, ys = self._eval_data()
+        aux = self._stack(model.aux_state) if model.aux_state else None
+        losses, accs = fed.evaluate(
+            self._stack(model.get_parameters()), xs, ys, aux=aux
+        )
+        return {
+            "test_loss": float(np.mean(np.asarray(losses))),
+            "test_metric": float(np.mean(np.asarray(accs))),
+        }

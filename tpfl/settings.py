@@ -68,6 +68,11 @@ class Settings:
     GOSSIP_MODELS_PERIOD: float = 1.0
     GOSSIP_MODELS_PER_ROUND: int = 2
     GOSSIP_EXIT_ON_X_EQUAL_ROUNDS: int = 10
+    # Downcast float parameters on the wire ("bfloat16"/"float16"; None
+    # = exact). Halves model-gossip bytes over DCN; receivers restore
+    # their model's own dtype on set. Lossy (~3 decimal digits for
+    # bf16) — FedAvg tolerates it, leave None for exact-repro runs.
+    WIRE_DTYPE: str | None = None
 
     # --- SSL / mTLS ---
     USE_SSL: bool = False
@@ -151,8 +156,11 @@ class Settings:
         cls.GOSSIP_MODELS_PERIOD = 0.05
         cls.GOSSIP_MODELS_PER_ROUND = 20
         cls.GOSSIP_EXIT_ON_X_EQUAL_ROUNDS = 50
-        cls.HEARTBEAT_PERIOD = 2.0
-        cls.HEARTBEAT_TIMEOUT = 10.0
+        # Heartbeats TTL-flood through relay hubs: at N nodes each beat
+        # costs O(N) relays, so the beat rate — not the timeout — sets
+        # the hub's floor load. 10s matches the standalone profile.
+        cls.HEARTBEAT_PERIOD = 10.0
+        cls.HEARTBEAT_TIMEOUT = 45.0
         cls.VOTE_TIMEOUT = 120.0
         cls.AGGREGATION_TIMEOUT = 120.0
         cls.WAIT_HEARTBEATS_CONVERGENCE = 0.5
