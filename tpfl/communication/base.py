@@ -314,5 +314,9 @@ class ThreadedCommunicationProtocol(CommunicationProtocol):
                     # Preserve the hop we received from, so the re-flood
                     # skips echoing straight back at it.
                     via=msg.via,
-                )
+                ),
+                # Liveness beats jump the relay queue: behind a vote
+                # burst they would arrive after HEARTBEAT_TIMEOUT and
+                # cause spurious evictions at scale.
+                priority=(msg.cmd == HEARTBEAT_CMD),
             )

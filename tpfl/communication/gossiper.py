@@ -42,6 +42,7 @@ class Gossiper(threading.Thread):
         self._send = send_fn
         self._get_neighbors = get_neighbors_fn
         self._pending: deque[Message] = deque()
+        self._priority: deque[Message] = deque()
         self._pending_lock = threading.Lock()
         # FIFO eviction ring + set: membership must be O(1) — a plain
         # deque scan is O(AMOUNT_LAST_MESSAGES_SAVED) per message and
@@ -71,16 +72,26 @@ class Gossiper(threading.Thread):
 
     # --- async message flood (reference gossiper.py:124-157) ---
 
-    def add_message(self, msg: Message) -> None:
+    def add_message(self, msg: Message, priority: bool = False) -> None:
+        """Queue for re-flood. ``priority`` classes the message as
+        liveness traffic (heartbeats): it must not sit behind a vote /
+        status burst at a relay hub, or peers evict each other while the
+        queue drains. Two FIFO classes — priority drains first each
+        period, normal traffic gets the remaining budget, so neither
+        class can starve the other as long as liveness volume alone
+        stays under the per-period cap."""
         with self._pending_lock:
-            self._pending.append(msg)
+            (self._priority if priority else self._pending).append(msg)
 
     def run(self) -> None:
         while not self._stop_event.is_set():
             batch: list[Message] = []
             with self._pending_lock:
+                budget = Settings.GOSSIP_MESSAGES_PER_PERIOD
+                for _ in range(min(len(self._priority), budget)):
+                    batch.append(self._priority.popleft())
                 for _ in range(
-                    min(len(self._pending), Settings.GOSSIP_MESSAGES_PER_PERIOD)
+                    min(len(self._pending), budget - len(batch))
                 ):
                     batch.append(self._pending.popleft())
             if batch:

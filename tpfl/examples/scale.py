@@ -12,6 +12,13 @@ nodes per round.
 Run: ``tpfl experiment run scale -- --nodes 100 --rounds 2`` (or
 ``python -m tpfl.examples.scale``). Prints per-round wall time and
 rounds/sec at the end.
+
+Scale envelope: the protocol layer is Python threads, so its ceiling is
+host cores, not the TPU — a single-core host sustains ~200 nodes (vote
+floods cost O(N^2) relays/round through a star hub). For 1000-node
+federations use the vmapped path directly (bench.py's config-4 tier:
+``VmapFederation`` with a participation mask — the whole round is one
+XLA program and the protocol overhead disappears).
 """
 
 from __future__ import annotations

@@ -170,18 +170,21 @@ class VmapFederation:
                 p = optax.apply_updates(p, updates)
                 return (p, o), loss
 
-            def epoch_body(_, carry):
-                (p, o), losses = jax.lax.scan(batch_step, carry, (xb, yb))
-                return (p, o)
+            if epochs <= 0:  # static: aggregation-only round
+                logits = module.apply({"params": params}, xb[0], train=False)
+                return params, loss_fn(logits, yb[0]).mean()
 
-            params, opt_state = jax.lax.fori_loop(
-                0, epochs, epoch_body, (params, opt_state)
+            def epoch_body(_, carry):
+                p, o, _last = carry
+                (p, o), losses = jax.lax.scan(batch_step, (p, o), (xb, yb))
+                # Thread the epoch's mean loss through the carry — no
+                # extra forward pass after the loop.
+                return (p, o, jnp.mean(losses))
+
+            params, opt_state, loss = jax.lax.fori_loop(
+                0, epochs, epoch_body, (params, opt_state, jnp.float32(0))
             )
-            # Report final-batch loss of last epoch via one extra pass?
-            # No: recompute mean loss on first batch is cheap and avoids
-            # threading losses through fori_loop.
-            logits = module.apply({"params": params}, xb[0], train=False)
-            return params, loss_fn(logits, yb[0]).mean()
+            return params, loss
 
         def round_impl(params, xs, ys, weights, epochs=1):
             trained, losses = jax.vmap(
@@ -232,15 +235,20 @@ class VmapFederation:
                 p = optax.apply_updates(p, updates)
                 return (p, o, new_a), loss
 
-            def epoch_body(_, carry):
-                carry, _losses = jax.lax.scan(batch_step, carry, (xb, yb))
-                return carry
+            if epochs <= 0:  # static: aggregation-only round
+                logits = module.apply({"params": params, **aux}, xb[0], train=False)
+                return params, aux, loss_fn(logits, yb[0]).mean()
 
-            params, opt_state, aux = jax.lax.fori_loop(
-                0, epochs, epoch_body, (params, opt_state, aux)
+            def epoch_body(_, carry):
+                p, o, a, _last = carry
+                (p, o, a), losses = jax.lax.scan(batch_step, (p, o, a), (xb, yb))
+                return (p, o, a, jnp.mean(losses))
+
+            params, opt_state, aux, loss = jax.lax.fori_loop(
+                0, epochs, epoch_body,
+                (params, opt_state, aux, jnp.float32(0)),
             )
-            logits = module.apply({"params": params, **aux}, xb[0], train=False)
-            return params, aux, loss_fn(logits, yb[0]).mean()
+            return params, aux, loss
 
         def round_impl(params, aux, xs, ys, weights, epochs=1):
             trained, new_aux, losses = jax.vmap(
