@@ -61,3 +61,148 @@ def test_settings_profiles_and_snapshot():
     Settings.TRAIN_SET_SIZE = 99
     Settings.restore(snap)
     assert Settings.TRAIN_SET_SIZE == snap["TRAIN_SET_SIZE"]
+
+
+# --- checkpoint/resume (capability the reference lacks, SURVEY §5.4) ------
+
+
+def test_node_checkpoint_roundtrip(tmp_path):
+    import numpy as np
+
+    from tpfl.management.checkpoint import (
+        load_node_checkpoint,
+        save_node_checkpoint,
+    )
+    from tpfl.models import create_model
+
+    model = create_model("mlp", (28, 28), seed=3, hidden_sizes=(16,))
+    model.set_contribution(["node-a"], 123)
+    model.add_info("scaffold", {"mu": 0.5})
+    save_node_checkpoint(str(tmp_path), model, round=7, exp_name="exp_x")
+
+    template = create_model("mlp", (28, 28), seed=9, hidden_sizes=(16,))
+    restored, meta = load_node_checkpoint(str(tmp_path), template)
+    assert meta["round"] == 7 and meta["exp_name"] == "exp_x"
+    assert restored.get_num_samples() == 123
+    assert restored.get_info("scaffold") == {"mu": 0.5}
+    for a, b in zip(
+        restored.get_parameters_list(), model.get_parameters_list()
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_node_checkpoint_with_batchnorm_aux(tmp_path):
+    import numpy as np
+
+    from tpfl.management.checkpoint import (
+        load_node_checkpoint,
+        save_node_checkpoint,
+    )
+    from tpfl.models import create_model
+
+    model = create_model("resnet18", (8, 8, 3), seed=0, stage_sizes=(1,), out_channels=4)
+    assert model.aux_state
+    save_node_checkpoint(str(tmp_path), model, round=1)
+    restored, _ = load_node_checkpoint(
+        str(tmp_path), create_model("resnet18", (8, 8, 3), seed=5, stage_sizes=(1,), out_channels=4)
+    )
+    import jax
+
+    for a, b in zip(
+        jax.tree_util.tree_leaves(restored.aux_state),
+        jax.tree_util.tree_leaves(model.aux_state),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_slice_checkpointer_sharded_roundtrip(tmp_path):
+    """Orbax roundtrip of a mesh-sharded node-stacked pytree (the
+    VmapFederation resume path)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpfl.management.checkpoint import SliceCheckpointer
+    from tpfl.models import MLP
+    from tpfl.parallel import VmapFederation, create_mesh
+
+    mesh = create_mesh({"nodes": 8})
+    fed = VmapFederation(MLP(hidden_sizes=(8,), compute_dtype=jnp.float32), 8, mesh=mesh)
+    params = fed.init_params((28, 28))
+
+    ck = SliceCheckpointer(str(tmp_path / "slice"))
+    ck.save(3, params)
+    assert ck.latest_step() == 3
+    restored = ck.restore(3, jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
+        params,
+    ))
+    for a, b in zip(
+        jax.tree_util.tree_leaves(restored), jax.tree_util.tree_leaves(params)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert a.sharding == b.sharding
+
+
+def test_node_checkpoint_resume_integration(tmp_path):
+    """A node checkpoints after an experiment; a fresh node restores
+    the weights and evaluates identically (restart-recovery story)."""
+    import numpy as np
+
+    from tpfl.communication.memory import clear_registry
+    from tpfl.learning.dataset import RandomIIDPartitionStrategy, synthetic_mnist
+    from tpfl.models import create_model
+    from tpfl.node import Node
+
+    clear_registry()
+    ds = synthetic_mnist(n_train=200, n_test=50, seed=0)
+    (part,) = ds.generate_partitions(1, RandomIIDPartitionStrategy, seed=0)
+    node = Node(create_model("mlp", (28, 28), seed=1), part, addr="ckpt-a")
+    node.start()
+    try:
+        node.learner.set_epochs(1)
+        node.learner.fit()
+        before = node.learner.evaluate()
+        node.save_checkpoint(str(tmp_path))
+    finally:
+        node.stop()
+
+    node2 = Node(create_model("mlp", (28, 28), seed=2), part, addr="ckpt-b")
+    node2.start()
+    try:
+        meta = node2.load_checkpoint(str(tmp_path))
+        assert "round" in meta
+        after = node2.learner.evaluate()
+        assert np.isclose(after["test_metric"], before["test_metric"])
+        assert np.isclose(after["test_loss"], before["test_loss"], atol=1e-5)
+    finally:
+        node2.stop()
+        clear_registry()
+
+
+def test_checkpoint_exact_under_wire_compression(tmp_path):
+    """Checkpoints are durable storage: they must stay exact even when
+    lossy wire compression (Settings.WIRE_DTYPE) is enabled."""
+    import numpy as np
+
+    from tpfl.management.checkpoint import (
+        load_node_checkpoint,
+        save_node_checkpoint,
+    )
+    from tpfl.models import create_model
+    from tpfl.settings import Settings
+
+    model = create_model("mlp", (28, 28), seed=4, hidden_sizes=(16,))
+    prev = Settings.WIRE_DTYPE
+    Settings.WIRE_DTYPE = "bfloat16"
+    try:
+        save_node_checkpoint(str(tmp_path), model, round=0)
+        restored, _ = load_node_checkpoint(
+            str(tmp_path), create_model("mlp", (28, 28), seed=8, hidden_sizes=(16,))
+        )
+        for a, b in zip(
+            restored.get_parameters_list(), model.get_parameters_list()
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    finally:
+        Settings.WIRE_DTYPE = prev

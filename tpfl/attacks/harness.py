@@ -54,6 +54,13 @@ def run_seeded_experiment(
     """
     prev_seed = Settings.SEED
     Settings.SEED = seed
+    # Reproducibility beats latency here: a vote/aggregation timeout
+    # firing under host load would truncate the tally and elect a
+    # different train set in one run but not the other — the exact
+    # nondeterminism this harness exists to rule out.
+    prev_vote, prev_agg = Settings.VOTE_TIMEOUT, Settings.AGGREGATION_TIMEOUT
+    Settings.VOTE_TIMEOUT = max(prev_vote, 300.0)
+    Settings.AGGREGATION_TIMEOUT = max(prev_agg, 300.0)
     nodes: list[Node] = []
     try:
         data = (
@@ -103,6 +110,8 @@ def run_seeded_experiment(
         for node in nodes:
             node.stop()
         Settings.SEED = prev_seed
+        Settings.VOTE_TIMEOUT = prev_vote
+        Settings.AGGREGATION_TIMEOUT = prev_agg
 
 
 def metric_table(exp_name: str) -> dict[str, dict[str, list]]:

@@ -94,6 +94,13 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
         "--no-show-metrics", dest="show_metrics", action="store_false"
     )
     p.add_argument("--measure-time", action="store_true")
+    p.add_argument(
+        "--profiling",
+        action="store_true",
+        help="cProfile the experiment; writes digits.prof + prints the "
+        "top cumulative entries (reference mnist.py --profiling uses "
+        "yappi, unavailable here).",
+    )
     args = p.parse_args(argv)
     args.topology = TopologyType(args.topology)
     return args
@@ -186,7 +193,21 @@ def digits(args: argparse.Namespace) -> list[Node]:
 
 
 def main(argv: list[str] | None = None) -> None:
-    digits(parse_args(argv))
+    args = parse_args(argv)
+    if args.profiling:
+        import cProfile
+        import pstats
+
+        prof = cProfile.Profile()
+        prof.enable()
+        try:
+            digits(args)
+        finally:
+            prof.disable()
+            prof.dump_stats("digits.prof")
+            pstats.Stats(prof).sort_stats("cumulative").print_stats(20)
+    else:
+        digits(args)
 
 
 if __name__ == "__main__":

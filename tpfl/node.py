@@ -222,6 +222,36 @@ class Node:
         st.votes_ready_event.set()
         self.aggregator.clear()
 
+    # --- checkpoint / resume (capability beyond the reference,
+    #     SURVEY §5.4: "no checkpoint-based recovery") ---
+
+    def save_checkpoint(self, directory: str) -> None:
+        """Persist this node's model + round metadata. A node restarted
+        from a checkpoint rejoins the federation and is caught up by
+        FullModelCommand gossip from the current round onward."""
+        from tpfl.management.checkpoint import save_node_checkpoint
+
+        save_node_checkpoint(
+            directory,
+            self.learner.get_model(),
+            round=self.state.round,
+            exp_name=self.state.exp_name,
+        )
+        logger.info(self.addr, f"Checkpoint saved to {directory}")
+
+    def load_checkpoint(self, directory: str) -> dict:
+        """Restore model weights saved by :meth:`save_checkpoint`;
+        returns the checkpoint metadata. Call before (re)starting
+        learning — mid-experiment state is protocol-owned."""
+        from tpfl.management.checkpoint import load_node_checkpoint
+
+        model, meta = load_node_checkpoint(
+            directory, self.learner.get_model()
+        )
+        self.learner.set_model(model)
+        logger.info(self.addr, f"Checkpoint loaded from {directory}")
+        return meta
+
     # --- introspection ---
 
     def learning_finished(self) -> bool:
