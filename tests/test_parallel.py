@@ -331,3 +331,116 @@ def test_federation_learner_hierarchical():
         for nd in nodes:
             nd.stop()
         clear_registry()
+
+
+# --- sequence parallelism: ring attention --------------------------------
+
+
+def _dense_attention(q, k, v, causal):
+    import jax as _jax
+
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+    if causal:
+        S = q.shape[1]
+        mask = np.tril(np.ones((S, S), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = _jax.nn.softmax(s, axis=-1)
+    return jnp.moveaxis(jnp.einsum("bhqk,bkhd->bhqd", p, v), 1, 2)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_dense(causal):
+    from tpfl.parallel.ring_attention import (
+        blockwise_attention,
+        make_ring_attention,
+    )
+
+    rng = np.random.default_rng(0)
+    B, S, H, D = 2, 64, 4, 16
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+        for _ in range(3)
+    )
+    want = _dense_attention(q, k, v, causal)
+    got_block = blockwise_attention(q, k, v, causal=causal, block_size=16)
+    np.testing.assert_allclose(np.asarray(got_block), np.asarray(want), atol=2e-5)
+    mesh = create_mesh({"sp": 8})
+    ring = make_ring_attention(mesh, causal=causal)
+    got_ring = ring(q, k, v)
+    np.testing.assert_allclose(np.asarray(got_ring), np.asarray(want), atol=2e-5)
+
+
+def test_ring_attention_grads_flow():
+    """Training through the ring: grads propagate through ppermute
+    (sequence-parallel backprop)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec
+
+    from tpfl.parallel.ring_attention import ring_attention
+
+    mesh = create_mesh({"sp": 8})
+    rng = np.random.default_rng(1)
+    B, S, H, D = 1, 32, 2, 8
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+        for _ in range(3)
+    )
+    spec = PartitionSpec(None, "sp", None, None)
+    from functools import partial
+
+    fn = shard_map(
+        partial(ring_attention, axis_name="sp", causal=True),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )
+
+    def loss(q, k, v):
+        return jnp.sum(fn(q, k, v) ** 2)
+
+    g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+
+    def dense_loss(q, k, v):
+        return jnp.sum(_dense_attention(q, k, v, True) ** 2)
+
+    gd = jax.jit(jax.grad(dense_loss, argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(g, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_transformer_lm_trains():
+    """The long-context zoo tier: a tiny causal LM fits a repeating
+    sequence (loss drops) with the standard learner machinery."""
+    import optax
+
+    from tpfl.models import create_model
+
+    model = create_model(
+        "transformer_lm", (32,), seed=0,
+        vocab=17, dim=32, heads=2, n_layers=1,
+    )
+    module = model.module
+    params = model.get_parameters()
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, 16, (4, 33)), jnp.int32)
+    x, y = tokens[:, :-1], tokens[:, 1:]
+    tx = optax.adam(1e-2)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(params, opt):
+        def loss_fn(p):
+            logits = module.apply({"params": p}, x, train=True)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, y
+            ).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt = tx.update(grads, opt, params)
+        return optax.apply_updates(params, updates), opt, loss
+
+    losses = []
+    for _ in range(30):
+        params, opt, loss = step(params, opt)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.8, losses[::10]

@@ -27,6 +27,7 @@ from tpfl.attacks.attacks import (
 )
 from tpfl.attacks.harness import (
     assert_tables_allclose,
+    final_values,
     flatten_table,
     metric_table,
     run_seeded_experiment,
@@ -40,6 +41,7 @@ __all__ = [
     "make_adversary",
     "run_seeded_experiment",
     "metric_table",
+    "final_values",
     "flatten_table",
     "assert_tables_allclose",
 ]

@@ -27,15 +27,7 @@ from tpfl.learning.model import TpflModel
 _MODEL_FILE = "model.tpfl"
 _AUX_FILE = "aux.tpfl"
 _META_FILE = "meta.json"
-
-
-def _atomic_write(path: str, data: bytes) -> None:
-    """tmp + rename: a crash mid-save must not destroy the previous
-    good checkpoint — that crash is the scenario checkpoints exist for."""
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(data)
-    os.replace(tmp, path)
+_LATEST = "LATEST"
 
 
 def save_node_checkpoint(
@@ -45,29 +37,77 @@ def save_node_checkpoint(
     exp_name: Optional[str] = None,
     extra: Optional[dict[str, Any]] = None,
 ) -> None:
-    """Persist a node's model + round metadata into ``directory``."""
+    """Persist a node's model + round metadata into ``directory``.
+
+    Atomic as a UNIT: every file of one save lands in a fresh subdir,
+    and only then does a single ``os.replace`` of the ``LATEST`` pointer
+    publish it — a crash at any point leaves the previous complete
+    checkpoint intact (no torn model/aux/meta mix), and stale aux from
+    an earlier save can never attach to a model without one."""
     os.makedirs(directory, exist_ok=True)
+    import uuid
+
+    sub = f"ckpt_{uuid.uuid4().hex[:8]}"
+    path = os.path.join(directory, sub)
+    os.makedirs(path)
     # Encode directly (NOT model.encode_parameters, which applies the
     # lossy Settings.WIRE_DTYPE downcast): checkpoints are durable
     # storage, not wire traffic — they must be exact.
-    _atomic_write(
-        os.path.join(directory, _MODEL_FILE),
-        serialization.encode_model_payload(
-            model.get_parameters(),
-            model._contributors,  # may legitimately be empty pre-fit
-            model.get_num_samples(),
-            model.get_info(),
-        ),
-    )
-    if model.aux_state:
-        _atomic_write(
-            os.path.join(directory, _AUX_FILE),
-            serialization.encode_model_payload(model.aux_state, [], 0, {}),
+    with open(os.path.join(path, _MODEL_FILE), "wb") as f:
+        f.write(
+            serialization.encode_model_payload(
+                model.get_parameters(),
+                model._contributors,  # may legitimately be empty pre-fit
+                model.get_num_samples(),
+                model.get_info(),
+            )
         )
+    if model.aux_state:
+        with open(os.path.join(path, _AUX_FILE), "wb") as f:
+            f.write(
+                serialization.encode_model_payload(model.aux_state, [], 0, {})
+            )
     meta = {"round": round, "exp_name": exp_name, **(extra or {})}
-    _atomic_write(
-        os.path.join(directory, _META_FILE), json.dumps(meta).encode()
-    )
+    with open(os.path.join(path, _META_FILE), "w") as f:
+        json.dump(meta, f)
+
+    pointer_tmp = os.path.join(directory, _LATEST + ".tmp")
+    with open(pointer_tmp, "w") as f:
+        f.write(sub)
+    os.replace(pointer_tmp, os.path.join(directory, _LATEST))  # publish
+    _sweep_unpublished(directory, keep=sub)
+
+
+def _sweep_unpublished(
+    directory: str, keep: str, grace_seconds: float = 60.0
+) -> None:
+    """Prune ckpt_* dirs that are not the published one — superseded
+    checkpoints, and orphans from crashes mid-save. An age grace window
+    protects a concurrent reader that resolved LATEST just before a new
+    publish (deleting its dir mid-read would raise FileNotFoundError on
+    a checkpoint that was complete)."""
+    import shutil
+    import time
+
+    now = time.time()
+    published = _read_latest(directory)
+    for name in os.listdir(directory):
+        if not name.startswith("ckpt_") or name in (keep, published):
+            continue
+        path = os.path.join(directory, name)
+        try:
+            if now - os.path.getmtime(path) > grace_seconds:
+                shutil.rmtree(path, ignore_errors=True)
+        except OSError:
+            pass
+
+
+def _read_latest(directory: str) -> Optional[str]:
+    try:
+        with open(os.path.join(directory, _LATEST)) as f:
+            return f.read().strip()
+    except FileNotFoundError:
+        return None
 
 
 def load_node_checkpoint(
@@ -78,14 +118,20 @@ def load_node_checkpoint(
     ``template`` supplies the architecture (flax module + param
     structure); the checkpointed params/info are loaded into a copy.
     """
-    with open(os.path.join(directory, _MODEL_FILE), "rb") as f:
+    sub = _read_latest(directory)
+    if sub is None:
+        raise FileNotFoundError(f"No checkpoint published in {directory}")
+    path = os.path.join(directory, sub)
+    with open(os.path.join(path, _MODEL_FILE), "rb") as f:
         model = template.build_copy(params=f.read())
-    aux_path = os.path.join(directory, _AUX_FILE)
+    aux_path = os.path.join(path, _AUX_FILE)
     if os.path.exists(aux_path):
         with open(aux_path, "rb") as f:
             aux, _, _, _ = serialization.decode_model_payload(f.read())
         model.aux_state = aux
-    with open(os.path.join(directory, _META_FILE)) as f:
+    else:
+        model.aux_state = None
+    with open(os.path.join(path, _META_FILE)) as f:
         meta = json.load(f)
     return model, meta
 

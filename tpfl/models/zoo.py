@@ -1,4 +1,4 @@
-"""Flax modules: MLP, CNN, ResNet-18.
+"""Flax modules: MLP, CNN, ResNet-18, TransformerLM.
 
 TPU notes: every module takes ``compute_dtype`` (default bfloat16 on TPU
 via Settings.DEFAULT_DTYPE staying float32 for params) so the MXU sees
@@ -139,12 +139,88 @@ def create_model(
             "mlp": MLP,
             "cnn": CNN,
             "resnet18": ResNet18,
+            "transformer_lm": TransformerLM,
         }
         if module not in zoo:
             raise KeyError(f"Unknown model {module!r}; have {sorted(zoo)}")
         module = zoo[module](**module_kwargs)
-    dummy = jnp.zeros((1, *input_shape), jnp.float32)
+    # Token models declare input_dtype (e.g. TransformerLM: int32 ids).
+    dummy = jnp.zeros(
+        (1, *input_shape), getattr(module, "input_dtype", jnp.float32)
+    )
     variables = module.init(jax.random.PRNGKey(seed), dummy, train=False)
     params = variables["params"]
     aux = {k: v for k, v in variables.items() if k != "params"} or None
     return TpflModel(module=module, params=params, aux_state=aux)
+
+
+class TransformerBlock(nn.Module):
+    """Pre-norm attention + MLP block; attention is blockwise
+    (flash-style, O(block^2) memory) via
+    :func:`tpfl.parallel.ring_attention.blockwise_attention`."""
+
+    dim: int
+    heads: int = 4
+    mlp_ratio: int = 4
+    causal: bool = True
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        from tpfl.parallel.ring_attention import blockwise_attention
+
+        b, s, _ = x.shape
+        h, d = self.heads, self.dim // self.heads
+        y = nn.LayerNorm(dtype=self.compute_dtype)(x)
+        qkv = nn.Dense(3 * self.dim, use_bias=False, dtype=self.compute_dtype)(y)
+        q, k, v = jnp.split(qkv.reshape(b, s, 3 * h, d), 3, axis=2)
+        attn = blockwise_attention(q, k, v, causal=self.causal)
+        x = x + nn.Dense(self.dim, dtype=self.compute_dtype)(
+            attn.reshape(b, s, self.dim)
+        )
+        y = nn.LayerNorm(dtype=self.compute_dtype)(x)
+        y = nn.Dense(self.mlp_ratio * self.dim, dtype=self.compute_dtype)(y)
+        y = nn.gelu(y)
+        return x + nn.Dense(self.dim, dtype=self.compute_dtype)(y)
+
+
+class TransformerLM(nn.Module):
+    """Small causal language model — the long-context tier of the zoo.
+
+    The reference has no attention models at all (SURVEY §5.7); this is
+    the consumer for the sequence-parallel path: single-device training
+    uses blockwise attention, and sequence-sharded training swaps in
+    :func:`tpfl.parallel.ring_attention.ring_attention` over an ``sp``
+    mesh axis (see tests/test_parallel.py).
+    """
+
+    vocab: int = 256
+    dim: int = 128
+    heads: int = 4
+    n_layers: int = 2
+    max_len: int = 8192
+    compute_dtype: Any = jnp.bfloat16
+
+    # create_model inits token models from integer ids (not a dataclass
+    # field: architecture metadata, not a hyperparameter).
+    input_dtype = jnp.int32
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        if tokens.shape[1] > self.max_len:
+            raise ValueError(
+                f"Sequence length {tokens.shape[1]} exceeds max_len="
+                f"{self.max_len}; raise max_len (positional table size)"
+            )
+        x = nn.Embed(self.vocab, self.dim, dtype=self.compute_dtype)(tokens)
+        pos = nn.Embed(self.max_len, self.dim, dtype=self.compute_dtype)(
+            jnp.arange(tokens.shape[1])[None]
+        )
+        x = x + pos
+        for _ in range(self.n_layers):
+            x = TransformerBlock(
+                self.dim, self.heads, compute_dtype=self.compute_dtype
+            )(x, train=train)
+        x = nn.LayerNorm(dtype=self.compute_dtype)(x)
+        logits = nn.Dense(self.vocab, dtype=self.compute_dtype)(x)
+        return logits.astype(jnp.float32)

@@ -1,0 +1,187 @@
+"""Ring attention — sequence/context parallelism over a mesh axis.
+
+Green-field TPU capability (SURVEY §5.7: the reference has no attention
+models and no sequence parallelism of any kind). Long sequences shard
+over a ``sp`` mesh axis: every device holds one block of Q, K and V;
+K/V blocks rotate around the ring with ``jax.lax.ppermute`` (one hop
+per step, riding ICI) while each device accumulates its Q block's
+attention with a numerically-stable online softmax (the
+log-sum-exp-carrying accumulation of Liu et al. 2023 "Ring Attention
+with Blockwise Transformers" / Milakov & Gimelshein 2018). No device
+ever materializes the full [S, S] score matrix or the full K/V.
+
+Memory per device: O(S/n · d) activations + O((S/n)²) scores — a 128k
+sequence on 8 devices attends with 16k-sized blocks.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def _block_attend(q, k, v, acc, row_max, denom, mask):
+    """Fold one K/V block into the running (acc, row_max, denom).
+
+    q: [B, Lq, H, D], k/v: [B, Lk, H, D]; mask: [Lq, Lk] boolean or
+    None. Online softmax: rescale previous accumulators by
+    exp(old_max - new_max), add this block's exp-weighted values.
+    """
+    scale = 1.0 / jnp.sqrt(q.shape[-1])
+    # [B, H, Lq, Lk]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if mask is not None:
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    block_max = jnp.max(scores, axis=-1)  # [B, H, Lq]
+    new_max = jnp.maximum(row_max, block_max)
+    # exp(-inf - -inf) guards: rows with no visible keys yet keep -inf.
+    correction = jnp.exp(jnp.where(row_max == -jnp.inf, -jnp.inf, row_max - new_max))
+    p = jnp.exp(scores - new_max[..., None])  # [B, H, Lq, Lk]
+    p = jnp.where(jnp.isnan(p), 0.0, p)  # -inf - -inf rows
+    acc = acc * correction[..., None] + jnp.einsum(
+        "bhqk,bkhd->bhqd", p, v.astype(jnp.float32)
+    )
+    denom = denom * correction + jnp.sum(p, axis=-1)
+    return acc, new_max, denom
+
+
+def blockwise_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = False,
+    block_size: Optional[int] = None,
+) -> jnp.ndarray:
+    """Single-device flash-style attention, blocked over BOTH queries
+    and keys: peak score memory is O(block²) per (batch, head), never
+    O(S²) or O(S·block). The causal inner loop's trip count is the
+    query block index + 1, so fully-masked future K/V blocks are never
+    computed (≈2× fewer FLOPs). q/k/v: [B, S, H, D] -> [B, S, H, D]."""
+    b, s, h, d = q.shape
+    block = block_size or min(s, 512)
+    n_blocks = -(-s // block)
+    pad = n_blocks * block - s
+    if pad:
+        qp = jnp.pad(q, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        kp = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        vp = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    else:
+        qp, kp, vp = q, k, v
+    qb = qp.reshape(b, n_blocks, block, h, d)
+    kb = kp.reshape(b, n_blocks, block, h, d)
+    vb = vp.reshape(b, n_blocks, block, h, d)
+    local_idx = jnp.arange(block)
+
+    def per_q_block(i):
+        q_i = qb[:, i]
+        q_idx = i * block + local_idx
+
+        def body(j, carry):
+            def attend(c):
+                acc, row_max, denom = c
+                k_j = jax.lax.dynamic_index_in_dim(
+                    kb, j, axis=1, keepdims=False
+                )
+                v_j = jax.lax.dynamic_index_in_dim(
+                    vb, j, axis=1, keepdims=False
+                )
+                k_idx = j * block + local_idx
+                mask = jnp.broadcast_to(k_idx[None, :] < s, (block, block))
+                if causal:
+                    mask = mask & (q_idx[:, None] >= k_idx[None, :])
+                return _block_attend(q_i, k_j, v_j, *c, mask)
+
+            if causal:
+                # Blocks above the diagonal are fully masked: cond skips
+                # their compute at runtime yet stays reverse-mode
+                # differentiable (a dynamic fori_loop bound would not).
+                return jax.lax.cond(j <= i, attend, lambda c: c, carry)
+            return attend(carry)
+
+        acc = jnp.zeros((b, h, block, d), jnp.float32)
+        row_max = jnp.full((b, h, block), -jnp.inf, jnp.float32)
+        denom = jnp.zeros((b, h, block), jnp.float32)
+        acc, row_max, denom = jax.lax.fori_loop(
+            0, n_blocks, body, (acc, row_max, denom)
+        )
+        out = acc / jnp.maximum(denom[..., None], 1e-30)
+        return jnp.moveaxis(out, 1, 2)  # [B, block, H, D]
+
+    blocks = jax.lax.map(per_q_block, jnp.arange(n_blocks))
+    out = jnp.moveaxis(blocks, 0, 1).reshape(b, n_blocks * block, h, d)
+    return out[:, :s].astype(q.dtype)
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    causal: bool = False,
+) -> jnp.ndarray:
+    """Sequence-parallel attention INSIDE shard_map: q/k/v are the
+    LOCAL sequence blocks [B, S/n, H, D] of a sequence sharded over
+    ``axis_name``; K/V rotate the ring via ppermute. Returns the local
+    output block."""
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, lq, h, d = q.shape
+
+    q_pos = my * lq + jnp.arange(lq)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(t, carry):
+        acc, row_max, denom, kt, vt = carry
+        # At step t we hold the block that started on device (my - t).
+        src = (my - t) % n
+        k_pos = src * lq + jnp.arange(lq)
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]
+        else:
+            mask = None
+        acc, row_max, denom = _block_attend(q, kt, vt, acc, row_max, denom, mask)
+        kt = jax.lax.ppermute(kt, axis_name, perm)
+        vt = jax.lax.ppermute(vt, axis_name, perm)
+        return acc, row_max, denom, kt, vt
+
+    acc = jnp.zeros((b, h, lq, d), jnp.float32)
+    row_max = jnp.full((b, h, lq), -jnp.inf, jnp.float32)
+    denom = jnp.zeros((b, h, lq), jnp.float32)
+    acc, row_max, denom, _, _ = jax.lax.fori_loop(
+        0, n, body, (acc, row_max, denom, k, v)
+    )
+    out = acc / jnp.maximum(denom[..., None], 1e-30)
+    return jnp.moveaxis(out, 1, 2).astype(q.dtype)  # [B, Lq, H, D]
+
+
+def make_ring_attention(
+    mesh: Mesh, axis_name: str = "sp", causal: bool = False
+):
+    """shard_map-wrapped ring attention: takes GLOBAL [B, S, H, D]
+    arrays sharded (or shardable) over ``axis_name`` on the sequence
+    dimension, returns the global output with the same sharding."""
+    from jax import shard_map
+
+    spec = PartitionSpec(None, axis_name, None, None)
+
+    fn = shard_map(
+        partial(ring_attention, axis_name=axis_name, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+
+    def apply(q, k, v):
+        sharding = NamedSharding(mesh, spec)
+        return fn(
+            jax.device_put(q, sharding),
+            jax.device_put(k, sharding),
+            jax.device_put(v, sharding),
+        )
+
+    return jax.jit(apply)
