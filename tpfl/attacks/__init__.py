@@ -27,7 +27,6 @@ from tpfl.attacks.attacks import (
 )
 from tpfl.attacks.harness import (
     assert_tables_allclose,
-    final_values,
     flatten_table,
     metric_table,
     run_seeded_experiment,
@@ -41,7 +40,6 @@ __all__ = [
     "make_adversary",
     "run_seeded_experiment",
     "metric_table",
-    "final_values",
     "flatten_table",
     "assert_tables_allclose",
 ]

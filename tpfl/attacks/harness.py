@@ -131,16 +131,6 @@ def flatten_table(table: dict[str, dict[str, list]]) -> np.ndarray:
     return np.asarray(out, dtype=np.float64)
 
 
-def final_values(table: dict[str, dict[str, list]]) -> dict[tuple[str, str], float]:
-    """{(node, metric): value at the highest recorded round}."""
-    return {
-        (node, metric): float(max(series, key=lambda rv: rv[0])[1])
-        for node, metrics in table.items()
-        for metric, series in metrics.items()
-        if series
-    }
-
-
 def _series_maps(
     table: dict[str, dict[str, list]],
 ) -> dict[tuple[str, str], dict[int, float]]:
@@ -160,11 +150,11 @@ def assert_tables_allclose(
     """Two seeded runs must produce numerically identical metric tables
     up to float-reduction noise.
 
-    Compared per (node, metric) at the latest COMMON round: metric
-    gossip is best-effort (a flooded MetricsCommand can be lost under
-    load), so one run may simply be missing a round's entry — comparing
+    Compared per (node, metric) at every COMMON round: metric gossip is
+    best-effort (a flooded MetricsCommand can be lost under load), so
+    one run may simply be missing a round's entry — comparing
     "whatever came last" would then compare different rounds. For truly
-    seeded-identical runs, values at any shared round must agree.
+    seeded-identical runs, values at every shared round must agree.
     Aggregation math is canonically ordered (aggregator.py sorts by
     contributors), but with partial aggregation the gossip *merge
     topology* — which partial aggregates formed before full coverage —
@@ -183,10 +173,10 @@ def assert_tables_allclose(
         common = set(ma[key]) & set(mb[key])
         if not common:
             raise AssertionError(f"No common rounds for {key}")
-        r = max(common)
-        got.append(ma[key][r])
-        want.append(mb[key][r])
-        labels.append((key, r))
+        for r in sorted(common):  # EVERY shared round must agree
+            got.append(ma[key][r])
+            want.append(mb[key][r])
+            labels.append((key, r))
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), atol=atol,
         err_msg=f"compared (key, round): {labels}",

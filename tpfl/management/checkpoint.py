@@ -72,9 +72,18 @@ def save_node_checkpoint(
         json.dump(meta, f)
 
     pointer_tmp = os.path.join(directory, _LATEST + ".tmp")
+    old = _read_latest(directory)
     with open(pointer_tmp, "w") as f:
         f.write(sub)
     os.replace(pointer_tmp, os.path.join(directory, _LATEST))  # publish
+    if old and old != sub:
+        # Stamp the SUPERSESSION time: the sweep's reader-grace window
+        # must start now, not at the dir's creation (rounds can be far
+        # apart; age-from-creation would delete it instantly).
+        try:
+            os.utime(os.path.join(directory, old))
+        except OSError:
+            pass
     _sweep_unpublished(directory, keep=sub)
 
 
@@ -82,10 +91,11 @@ def _sweep_unpublished(
     directory: str, keep: str, grace_seconds: float = 60.0
 ) -> None:
     """Prune ckpt_* dirs that are not the published one — superseded
-    checkpoints, and orphans from crashes mid-save. An age grace window
-    protects a concurrent reader that resolved LATEST just before a new
-    publish (deleting its dir mid-read would raise FileNotFoundError on
-    a checkpoint that was complete)."""
+    checkpoints (mtime re-stamped at supersession) and orphans from
+    crashes mid-save. The grace window protects a concurrent reader
+    that resolved LATEST just before a new publish (deleting its dir
+    mid-read would raise FileNotFoundError on a checkpoint that was
+    complete and published moments earlier)."""
     import shutil
     import time
 

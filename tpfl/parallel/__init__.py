@@ -19,6 +19,12 @@ round-tripping through pickle on every hop. Here:
 from tpfl.parallel.mesh import create_mesh, federation_sharding, replicated
 from tpfl.parallel.federation import VmapFederation
 from tpfl.parallel.federation_learner import FederationLearner
+from tpfl.parallel.flash_kernel import flash_attention
+from tpfl.parallel.ring_attention import (
+    blockwise_attention,
+    make_ring_attention,
+    ring_attention,
+)
 from tpfl.parallel.sharded import ShardedTrainer
 
 __all__ = [
@@ -28,4 +34,8 @@ __all__ = [
     "VmapFederation",
     "FederationLearner",
     "ShardedTrainer",
+    "flash_attention",
+    "blockwise_attention",
+    "ring_attention",
+    "make_ring_attention",
 ]

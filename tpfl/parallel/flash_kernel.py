@@ -1,0 +1,132 @@
+"""Flash attention as a Pallas TPU kernel — the hot-op fast path.
+
+The pure-XLA :func:`tpfl.parallel.ring_attention.blockwise_attention`
+is correct and fuses well; this kernel goes further: the online-softmax
+accumulators for one query block live in VMEM scratch across the whole
+K/V sweep (K/V stream through VMEM one block at a time — sequence
+length is bounded by HBM, not by the ~16 MB VMEM), and the score
+matmuls run on the MXU.
+
+Grid: (batch·heads, query blocks, key blocks) — TPU executes the last
+grid dimension sequentially on the same core, so scratch carries the
+running (acc, max, denom) between key blocks; the first key block
+initializes them and the last one writes the output block. Causal
+programs above the diagonal skip all work via ``pl.when``.
+
+``flash_attention`` interprets on CPU (tests) and compiles on TPU.
+Forward-only (no custom VJP): it is the inference/serving fast path —
+training uses the differentiable XLA blockwise path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30  # large-negative instead of -inf: exp() stays exact, no NaNs
+
+
+def _kernel(
+    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+    *, block: int, causal: bool, scale: float,
+):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    run = (qi >= ki) if causal else (ki >= 0)
+
+    @pl.when(run)
+    def _attend():
+        q = q_ref[0].astype(jnp.float32) * scale  # [block, D]
+        k_j = k_ref[0].astype(jnp.float32)
+        v_j = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(  # [block, block] on the MXU
+            q, k_j, (((1,), (1,)), ((), ()))
+        )
+        if causal:
+            q_pos = qi * block + jax.lax.broadcasted_iota(
+                jnp.int32, (block, block), 0
+            )
+            k_pos = ki * block + jax.lax.broadcasted_iota(
+                jnp.int32, (block, block), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_prev = m_ref[:, :1]  # [block, 1]
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+            p, v_j, (((1,), (0,)), ((), ()))
+        )
+        m_ref[:, :1] = m_new
+        l_ref[:, :1] = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        denom = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = False,
+    block: int = 256,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Pallas flash attention. q/k/v: [B, S, H, D] -> [B, S, H, D].
+
+    Non-causal with a sequence that doesn't divide ``block`` falls back
+    to the XLA blockwise path (pad keys would need extra masking; the
+    causal mask already excludes the high-position pad keys)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, s, h, d = q.shape
+    blk = min(block, s)
+    s_pad = -(-s // blk) * blk
+    if not causal and s_pad != s:
+        from tpfl.parallel.ring_attention import blockwise_attention
+
+        return blockwise_attention(q, k, v, causal=False, block_size=blk)
+    d_pad = -(-d // 128) * 128
+
+    def prep(x):
+        x = jnp.moveaxis(x, 2, 1).reshape(b * h, s, d)  # [BH, S, D]
+        return jnp.pad(x, ((0, 0), (0, s_pad - s), (0, d_pad - d)))
+
+    qp, kp, vp = prep(q), prep(k), prep(v)
+    nblk = s_pad // blk
+    out = pl.pallas_call(
+        functools.partial(
+            _kernel, block=blk, causal=causal, scale=1.0 / (d**0.5)
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * h, s_pad, d_pad), q.dtype),
+        grid=(b * h, nblk, nblk),
+        in_specs=[
+            pl.BlockSpec((1, blk, d_pad), lambda bhi, qi, ki: (bhi, qi, 0)),
+            pl.BlockSpec((1, blk, d_pad), lambda bhi, qi, ki: (bhi, ki, 0)),
+            pl.BlockSpec((1, blk, d_pad), lambda bhi, qi, ki: (bhi, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, blk, d_pad), lambda bhi, qi, ki: (bhi, qi, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((blk, d_pad), jnp.float32),  # acc
+            pltpu.VMEM((blk, 128), jnp.float32),  # running max (col 0)
+            pltpu.VMEM((blk, 128), jnp.float32),  # running denom (col 0)
+        ],
+        interpret=interpret,
+    )(qp, kp, vp)
+    out = out[:, :s, :d].reshape(b, h, s, d)
+    return jnp.moveaxis(out, 1, 2)

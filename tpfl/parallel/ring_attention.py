@@ -141,9 +141,20 @@ def ring_attention(
         k_pos = src * lq + jnp.arange(lq)
         if causal:
             mask = q_pos[:, None] >= k_pos[None, :]
+
+            def attend(c):
+                return _block_attend(q, kt, vt, *c, mask)
+
+            # Blocks entirely in the future are fully masked: cond
+            # skips their einsums at runtime (~2x fewer FLOPs on
+            # average) and stays differentiable.
+            acc, row_max, denom = jax.lax.cond(
+                src <= my, attend, lambda c: c, (acc, row_max, denom)
+            )
         else:
-            mask = None
-        acc, row_max, denom = _block_attend(q, kt, vt, acc, row_max, denom, mask)
+            acc, row_max, denom = _block_attend(
+                q, kt, vt, acc, row_max, denom, None
+            )
         kt = jax.lax.ppermute(kt, axis_name, perm)
         vt = jax.lax.ppermute(vt, axis_name, perm)
         return acc, row_max, denom, kt, vt
