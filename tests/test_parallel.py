@@ -477,3 +477,37 @@ def test_flash_kernel_unaligned_causal():
     want = _dense_attention(q, k, v, True)
     got = flash_attention(q, k, v, causal=True, block=64)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_transformer_lm_with_ring_attention_seam():
+    """TransformerLM's attention_fn seam: the same model computes
+    identical logits with default blockwise attention and with
+    sequence-parallel ring attention over the 8-device mesh."""
+    from tpfl.models import create_model
+    from tpfl.parallel import make_ring_attention
+
+    model = create_model(
+        "transformer_lm", (64,), seed=0, vocab=32, dim=32, heads=2,
+        n_layers=1,
+    )
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, 31, (2, 64)), jnp.int32)
+    base = model.module.apply({"params": model.get_parameters()}, tokens)
+
+    mesh = create_mesh({"sp": 8})
+    ring = make_ring_attention(mesh, causal=True)
+    # The closure plugs in directly: it validates the causal kwarg the
+    # block passes, so a causality mismatch raises instead of silently
+    # attending the wrong way.
+    ring_module = type(model.module)(
+        vocab=32, dim=32, heads=2, n_layers=1, attention_fn=ring,
+    )
+    ringed = ring_module.apply({"params": model.get_parameters()}, tokens)
+    np.testing.assert_allclose(
+        np.asarray(ringed), np.asarray(base), atol=2e-4
+    )
+    with pytest.raises(ValueError, match="causal"):
+        make_ring_attention(mesh, causal=False)(
+            jnp.zeros((1, 8, 1, 8)), jnp.zeros((1, 8, 1, 8)),
+            jnp.zeros((1, 8, 1, 8)), causal=True,
+        )

@@ -155,26 +155,33 @@ def create_model(
 
 
 class TransformerBlock(nn.Module):
-    """Pre-norm attention + MLP block; attention is blockwise
-    (flash-style, O(block^2) memory) via
-    :func:`tpfl.parallel.ring_attention.blockwise_attention`."""
+    """Pre-norm attention + MLP block. ``attention_fn(q, k, v, causal)``
+    defaults to the differentiable flash-style
+    :func:`~tpfl.parallel.ring_attention.blockwise_attention`
+    (O(block²) score memory); pass a
+    :func:`~tpfl.parallel.ring_attention.ring_attention` closure for
+    sequence-sharded training or
+    :func:`~tpfl.parallel.flash_kernel.flash_attention` for the Pallas
+    serving fast path."""
 
     dim: int
     heads: int = 4
     mlp_ratio: int = 4
     causal: bool = True
     compute_dtype: Any = jnp.bfloat16
+    attention_fn: Optional[Callable] = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         from tpfl.parallel.ring_attention import blockwise_attention
 
+        attention = self.attention_fn or blockwise_attention
         b, s, _ = x.shape
         h, d = self.heads, self.dim // self.heads
         y = nn.LayerNorm(dtype=self.compute_dtype)(x)
         qkv = nn.Dense(3 * self.dim, use_bias=False, dtype=self.compute_dtype)(y)
         q, k, v = jnp.split(qkv.reshape(b, s, 3 * h, d), 3, axis=2)
-        attn = blockwise_attention(q, k, v, causal=self.causal)
+        attn = attention(q, k, v, causal=self.causal)
         x = x + nn.Dense(self.dim, dtype=self.compute_dtype)(
             attn.reshape(b, s, self.dim)
         )
@@ -200,6 +207,7 @@ class TransformerLM(nn.Module):
     n_layers: int = 2
     max_len: int = 8192
     compute_dtype: Any = jnp.bfloat16
+    attention_fn: Optional[Callable] = None  # see TransformerBlock
 
     # create_model inits token models from integer ids (not a dataclass
     # field: architecture metadata, not a hyperparameter).
@@ -219,7 +227,10 @@ class TransformerLM(nn.Module):
         x = x + pos
         for _ in range(self.n_layers):
             x = TransformerBlock(
-                self.dim, self.heads, compute_dtype=self.compute_dtype
+                self.dim,
+                self.heads,
+                compute_dtype=self.compute_dtype,
+                attention_fn=self.attention_fn,
             )(x, train=train)
         x = nn.LayerNorm(dtype=self.compute_dtype)(x)
         logits = nn.Dense(self.vocab, dtype=self.compute_dtype)(x)

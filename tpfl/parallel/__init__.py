@@ -19,13 +19,22 @@ round-tripping through pickle on every hop. Here:
 from tpfl.parallel.mesh import create_mesh, federation_sharding, replicated
 from tpfl.parallel.federation import VmapFederation
 from tpfl.parallel.federation_learner import FederationLearner
-from tpfl.parallel.flash_kernel import flash_attention
 from tpfl.parallel.ring_attention import (
     blockwise_attention,
     make_ring_attention,
     ring_attention,
 )
 from tpfl.parallel.sharded import ShardedTrainer
+
+
+def __getattr__(name):
+    # Lazy: flash_attention pulls jax.experimental.pallas (~1s import),
+    # a serving-only fast path most tpfl.parallel users never touch.
+    if name == "flash_attention":
+        from tpfl.parallel.flash_kernel import flash_attention
+
+        return flash_attention
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 __all__ = [
     "create_mesh",

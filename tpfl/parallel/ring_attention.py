@@ -187,12 +187,26 @@ def make_ring_attention(
         check_vma=False,
     )
 
-    def apply(q, k, v):
+    baked_causal = causal
+
+    def apply(q, k, v, causal: Optional[bool] = None):
+        # Causality is baked into the compiled program; accepting (and
+        # validating) the kwarg lets this closure plug directly into
+        # TransformerBlock's ``attention_fn(q, k, v, causal=...)`` seam
+        # without silently attending the wrong way.
+        if causal is not None and causal != baked_causal:
+            raise ValueError(
+                f"make_ring_attention was built with causal="
+                f"{baked_causal}, called with causal={causal}"
+            )
         sharding = NamedSharding(mesh, spec)
-        return fn(
+        return _jitted(
             jax.device_put(q, sharding),
             jax.device_put(k, sharding),
             jax.device_put(v, sharding),
         )
 
-    return jax.jit(apply)
+    _jitted = jax.jit(
+        lambda q, k, v: fn(q, k, v)
+    )
+    return apply
