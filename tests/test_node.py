@@ -376,3 +376,46 @@ def test_convergence_with_bf16_wire():
     finally:
         for nd in nodes:
             nd.stop()
+
+
+def test_late_joiner_participates_in_next_experiment():
+    """A node that joins mid-experiment idles (it never saw that
+    StartLearning flood), the running federation finishes undisturbed,
+    and a SECOND experiment then includes the joiner — sequential
+    experiments get distinct names and metric tables."""
+    from tpfl.management.logger import logger
+
+    nodes = build_nodes(2)
+    try:
+        nodes[0].connect(nodes[1].addr)
+        wait_convergence(nodes, 1, wait=10)
+        exp1 = nodes[0].set_start_learning(rounds=1, epochs=1)
+
+        late = Node(
+            create_model("mlp", (28, 28), seed=7, hidden_sizes=(32,)),
+            synthetic_mnist(n_train=200, n_test=40, seed=3, noise=0.4)
+            .generate_partitions(1, RandomIIDPartitionStrategy, seed=0)[0],
+            learning_rate=0.1,
+            batch_size=32,
+        )
+        late.start()
+        late.connect(nodes[0].addr)
+        nodes.append(late)
+
+        wait_to_finish(nodes[:2], timeout=180)
+        assert late.state.status == "Idle"  # never joined exp1
+
+        wait_convergence(nodes, 2, only_direct=False, wait=10)
+        exp2 = nodes[0].set_start_learning(rounds=1, epochs=1)
+        assert exp2 != exp1
+        wait_to_finish(nodes, timeout=180)
+        # The joiner ran the full stage workflow this time...
+        assert late.learning_workflow.history[0] == "StartLearningStage"
+        # ...and holds the aggregated model.
+        check_equal_models(nodes)
+        # Distinct experiments, distinct metric tables.
+        logs = logger.get_global_logs()
+        assert exp1 in logs and exp2 in logs
+    finally:
+        for nd in nodes:
+            nd.stop()

@@ -36,6 +36,10 @@ def __getattr__(name):
         return flash_attention
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))
+
 __all__ = [
     "create_mesh",
     "federation_sharding",

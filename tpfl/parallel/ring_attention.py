@@ -188,6 +188,8 @@ def make_ring_attention(
     )
 
     baked_causal = causal
+    sharding = NamedSharding(mesh, spec)
+    jitted = jax.jit(fn)
 
     def apply(q, k, v, causal: Optional[bool] = None):
         # Causality is baked into the compiled program; accepting (and
@@ -199,14 +201,10 @@ def make_ring_attention(
                 f"make_ring_attention was built with causal="
                 f"{baked_causal}, called with causal={causal}"
             )
-        sharding = NamedSharding(mesh, spec)
-        return _jitted(
+        return jitted(
             jax.device_put(q, sharding),
             jax.device_put(k, sharding),
             jax.device_put(v, sharding),
         )
 
-    _jitted = jax.jit(
-        lambda q, k, v: fn(q, k, v)
-    )
     return apply
