@@ -1,0 +1,126 @@
+"""Pipeline parallelism — GPipe-style microbatch schedule on a ``pp``
+mesh axis.
+
+Completes the parallelism inventory next to dp/FSDP (ShardedTrainer),
+sequence parallelism (ring_attention) and the federated node axis
+(VmapFederation). The reference has no intra-model parallelism at all
+(SURVEY §2.10).
+
+Design (TPU-idiomatic, no per-stage Python processes): the model is a
+stack of L identical blocks; each of the n pipeline stages owns L/n
+consecutive blocks (their params live only on that stage's device —
+total param memory is split n ways). Inside ``shard_map`` every stage
+runs the same SPMD program: at each of ``n_micro + n - 1`` ticks it
+applies its blocks to the activation it holds, then ``ppermute``\\ s the
+result to the next stage over ICI. Stage 0 feeds a fresh microbatch
+each tick; the last stage emits finished microbatches. Bubble fraction
+is the usual (n-1)/(n_micro + n - 1).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def _stage_apply(block_fn: Callable, stage_params, x):
+    """Apply this stage's chunk of blocks: scan over the local layer
+    axis (params stacked [layers_per_stage, ...])."""
+
+    def body(h, layer_params):
+        # Pin the carry dtype: a promoting block_fn (bf16 activations ×
+        # f32 params) must not break the scan carry-type invariant.
+        return block_fn(layer_params, h).astype(x.dtype), None
+
+    out, _ = jax.lax.scan(body, x, stage_params)
+    return out
+
+
+def pipeline_forward(
+    block_fn: Callable,
+    stage_params: Any,
+    microbatches: jnp.ndarray,
+    axis_name: str = "pp",
+) -> jnp.ndarray:
+    """Run inside shard_map. ``stage_params``: this stage's stacked
+    block params [L/n, ...]; ``microbatches``: [n_micro, mb, ...] —
+    replicated input (every stage sees it; only stage 0 consumes).
+    Returns [n_micro, mb, ...] finished activations (valid on the LAST
+    stage; other stages return garbage of the same shape)."""
+    n = jax.lax.psum(1, axis_name)
+    stage = jax.lax.axis_index(axis_name)
+    n_micro = microbatches.shape[0]
+    mb_shape = microbatches.shape[1:]
+    perm = [(i, i + 1) for i in range(n - 1)]  # forward shifts only
+
+    def tick(t, carry):
+        held, outputs = carry
+        # Stage 0 picks up microbatch t (if any left); others keep what
+        # the previous stage sent them.
+        feed = jax.lax.dynamic_index_in_dim(
+            microbatches, jnp.minimum(t, n_micro - 1), keepdims=False
+        )
+        x = jnp.where(stage == 0, feed, held)
+        y = _stage_apply(block_fn, stage_params, x)
+        # Last stage banks microbatch t - (n - 1) once it's real.
+        out_idx = t - (n - 1)
+        outputs = jax.lax.cond(
+            out_idx >= 0,
+            lambda o: jax.lax.dynamic_update_index_in_dim(
+                o, y, jnp.maximum(out_idx, 0), axis=0
+            ),
+            lambda o: o,
+            outputs,
+        )
+        # Hand activations down the pipe (stage i -> i+1).
+        held = jax.lax.ppermute(y, axis_name, perm)
+        return held, outputs
+
+    held = jnp.zeros(mb_shape, microbatches.dtype)
+    outputs = jnp.zeros((n_micro, *mb_shape), microbatches.dtype)
+    held, outputs = jax.lax.fori_loop(
+        0, n_micro + n - 1, tick, (held, outputs)
+    )
+    # Leading per-stage axis: only the LAST stage's outputs are real;
+    # the caller slices them out of the stage-sharded global result.
+    return outputs[None]
+
+
+def make_pipeline(
+    mesh: Mesh,
+    block_fn: Callable,
+    n_layers: int,
+    axis_name: str = "pp",
+):
+    """Build a jitted pipelined forward over ``mesh[axis_name]``.
+
+    ``block_fn(layer_params, x) -> x`` applies ONE block. Global params
+    arrive stacked [n_layers, ...] and are sharded so each stage holds
+    its own [n_layers/n, ...] slice (param memory splits across
+    stages). Microbatches are replicated in; outputs are read from the
+    last stage."""
+    n = mesh.shape[axis_name]
+    if n_layers % n:
+        raise ValueError(f"{n_layers} layers do not split over {n} stages")
+    param_spec = PartitionSpec(axis_name)
+
+    fn = jax.shard_map(
+        partial(pipeline_forward, block_fn, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(param_spec, PartitionSpec()),
+        out_specs=PartitionSpec(axis_name),  # per-stage leading axis
+        check_vma=False,
+    )
+
+    def apply(stacked_params: Any, microbatches: jnp.ndarray) -> jnp.ndarray:
+        stacked_params = jax.tree_util.tree_map(
+            lambda p: jax.device_put(p, NamedSharding(mesh, param_spec)),
+            stacked_params,
+        )
+        return fn(stacked_params, microbatches)[-1]  # last stage's bank
+
+    return jax.jit(apply)
