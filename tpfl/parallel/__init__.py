@@ -19,6 +19,7 @@ round-tripping through pickle on every hop. Here:
 from tpfl.parallel.mesh import create_mesh, federation_sharding, replicated
 from tpfl.parallel.federation import VmapFederation
 from tpfl.parallel.federation_learner import FederationLearner
+from tpfl.parallel.moe import make_moe_layer, moe_dispatch
 from tpfl.parallel.pipeline import make_pipeline, pipeline_forward
 from tpfl.parallel.ring_attention import (
     blockwise_attention,
@@ -53,5 +54,7 @@ __all__ = [
     "ring_attention",
     "make_ring_attention",
     "make_pipeline",
+    "make_moe_layer",
+    "moe_dispatch",
     "pipeline_forward",
 ]

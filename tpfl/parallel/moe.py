@@ -1,0 +1,129 @@
+"""Expert parallelism — top-1 MoE dispatch with all_to_all over an
+``ep`` mesh axis.
+
+Completes the parallelism inventory (dp/FSDP, sp ring attention, pp
+pipeline, federated nodes — and now ep). One expert per device: each
+device routes its local tokens (top-1), packs up to ``capacity`` tokens
+per destination expert into a static [n, C, D] dispatch buffer,
+``all_to_all`` swaps buffers so every device receives its expert's
+tokens from all peers, the local expert MLP runs, and a second
+``all_to_all`` returns results to the owning device, which scatters
+them back into token order. Over-capacity tokens pass through on the
+residual path (standard Switch-style dropping).
+
+Static shapes throughout — routing is data-dependent but expressed as
+argsort/segment ops, never shape-changing, so the whole layer jits.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def moe_dispatch(
+    x: jnp.ndarray,
+    expert_of: jnp.ndarray,
+    expert_fn: Callable[[jnp.ndarray], jnp.ndarray],
+    capacity: int,
+    axis_name: str = "ep",
+) -> jnp.ndarray:
+    """Run inside shard_map. ``x``: local tokens [T, D]; ``expert_of``:
+    [T] int32 — ids in [0, n) dispatch, anything else (e.g. -1) means
+    "drop". Returns [T, D]: expert outputs for dispatched tokens, the
+    token itself (residual passthrough) for dropped/over-capacity ones."""
+    n = jax.lax.psum(1, axis_name)
+    t, d = x.shape
+
+    valid = (expert_of >= 0) & (expert_of < n)
+    expert_of = jnp.where(valid, expert_of, 0)
+    # Position of each token within its expert's queue (stable order);
+    # invalid tokens occupy no slot.
+    onehot = jax.nn.one_hot(expert_of, n, dtype=jnp.int32) * valid[:, None]
+    pos_in_expert = jnp.cumsum(onehot, axis=0) * onehot  # 1-based
+    pos = jnp.sum(pos_in_expert, axis=1) - 1  # [T], 0-based; invalid -> -1
+    keep = valid & (pos < capacity)
+
+    # Pack tokens into the [n, C, D] dispatch buffer.
+    buf = jnp.zeros((n, capacity, d), x.dtype)
+    slot_e = jnp.where(keep, expert_of, 0)
+    slot_c = jnp.where(keep, pos, 0)
+    contrib = jnp.where(keep[:, None], x, 0.0)
+    buf = buf.at[slot_e, slot_c].add(contrib)
+
+    # Swap: device i's buf[e] goes to device e; device e receives its
+    # expert's tokens from everyone -> [n_src, C, D].
+    received = jax.lax.all_to_all(
+        buf, axis_name, split_axis=0, concat_axis=0, tiled=False
+    )
+    out = expert_fn(received.reshape(n * capacity, d)).reshape(
+        n, capacity, d
+    )
+    # Swap back: results return to the token owners.
+    returned = jax.lax.all_to_all(
+        out, axis_name, split_axis=0, concat_axis=0, tiled=False
+    )
+    gathered = returned[slot_e, slot_c]  # [T, D]
+    return jnp.where(keep[:, None], gathered, x)
+
+
+def make_moe_layer(
+    mesh: Mesh,
+    expert_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    router_fn: Callable[[jnp.ndarray], jnp.ndarray],
+    capacity: int,
+    axis_name: str = "ep",
+):
+    """Jitted expert-parallel layer over ``mesh[axis_name]``.
+
+    ``expert_fn(expert_params, tokens)``: one expert's computation;
+    expert params arrive stacked [n_experts, ...] and are sharded one
+    per device. ``router_fn(tokens) -> [T] int32`` picks the expert.
+    Tokens [T_global, D] are sharded over the axis."""
+    n = mesh.shape[axis_name]
+    param_spec = PartitionSpec(axis_name)
+    tok_spec = PartitionSpec(axis_name)
+
+    def local(params, x):
+        # Router ids outside [0, n) take the residual passthrough (the
+        # moe_dispatch drop convention) — never silently clamped onto a
+        # wrong expert.
+        expert_of = router_fn(x).astype(jnp.int32)
+        my_params = jax.tree_util.tree_map(lambda p: p[0], params)
+        return moe_dispatch(
+            x,
+            expert_of,
+            lambda toks: expert_fn(my_params, toks),
+            capacity,
+            axis_name,
+        )
+
+    fn = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(param_spec, tok_spec),
+        out_specs=tok_spec,
+        check_vma=False,
+    )
+
+    def apply(stacked_expert_params: Any, tokens: jnp.ndarray) -> jnp.ndarray:
+        for leaf in jax.tree_util.tree_leaves(stacked_expert_params):
+            if leaf.shape[0] != n:
+                raise ValueError(
+                    f"Expert param leading dim {leaf.shape[0]} != mesh "
+                    f"axis {axis_name}={n} (one expert per device; "
+                    f"p[0] would silently drop the rest)"
+                )
+        stacked_expert_params = jax.tree_util.tree_map(
+            lambda p: jax.device_put(p, NamedSharding(mesh, param_spec)),
+            stacked_expert_params,
+        )
+        return fn(
+            stacked_expert_params,
+            jax.device_put(tokens, NamedSharding(mesh, tok_spec)),
+        )
+
+    return jax.jit(apply)
