@@ -206,3 +206,57 @@ def test_checkpoint_exact_under_wire_compression(tmp_path):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     finally:
         Settings.WIRE_DTYPE = prev
+
+
+def test_web_services_client_against_local_server():
+    """The REST client (reference p2pfl_web_services.py:58-136 parity)
+    posts registration/logs/metrics with x-api-key auth — exercised
+    against a real local HTTP server, and failure-swallowing verified
+    against a dead endpoint (observability must never kill a node)."""
+    import json as _json
+    import threading
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    from tpfl.management.web_services import TpflWebServices
+
+    received = []
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            body = self.rfile.read(int(self.headers["Content-Length"]))
+            received.append(
+                (
+                    self.path,
+                    self.headers.get("x-api-key"),
+                    _json.loads(body),
+                )
+            )
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            self.wfile.write(b"{}")
+
+        def log_message(self, *a):  # quiet
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        ws = TpflWebServices(f"http://127.0.0.1:{srv.server_port}", "sekret")
+        ws.register_node("node-w", is_simulated=True)
+        ws.send_log("t0", "node-w", "INFO", "hello")
+        ws.send_local_metric("node-w", "loss", 1.5, step=3, round=0)
+        ws.send_global_metric("node-w", "acc", 0.9, round=1)
+        ws.send_system_metric("node-w", "cpu", 0.5, "t1")
+        assert len(received) == 5
+        assert all(key == "sekret" for _, key, _b in received)
+        paths = [p for p, _, _ in received]
+        assert any("node" in p for p in paths)
+    finally:
+        srv.shutdown()
+
+    # Dead endpoint: every call swallows the failure.
+    dead = TpflWebServices("http://127.0.0.1:9", "k")
+    dead.register_node("n", False)
+    dead.send_log("t", "n", "INFO", "m")  # no raise = pass
