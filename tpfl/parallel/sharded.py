@@ -23,14 +23,22 @@ from tpfl.learning.jax_learner import cross_entropy_loss, default_optimizer
 
 
 def fsdp_spec(leaf: Any, axis: str, axis_size: int) -> PartitionSpec:
-    """Per-leaf FSDP heuristic: shard the largest divisible dim;
-    replicate small/indivisible leaves."""
+    """Per-leaf FSDP heuristic: shard the last divisible dim; replicate
+    small/indivisible leaves.
+
+    Why the LAST dim: any dim gives the same 1/axis_size storage, but
+    kernels are [..., in, out] and the backward w.r.t. activations
+    contracts over ``out`` — with ``out`` sharded, XLA resolves the
+    cotangent with an all-reduce and it comes out replicated, so a
+    following transpose/reshape (e.g. the CNN flatten's transpose)
+    re-shards cleanly to batch sharding. Sharding ``in`` instead leaves
+    cotangents feature-sharded and triggered XLA's "[SPMD] Involuntary
+    full rematerialization" on the flatten reshape (seen in round 2's
+    MULTICHIP log)."""
     shape = np.shape(leaf)
     if not shape:
         return PartitionSpec()
-    # Prefer the largest dimension divisible by the axis size.
-    order = sorted(range(len(shape)), key=lambda i: -shape[i])
-    for i in order:
+    for i in reversed(range(len(shape))):
         if shape[i] % axis_size == 0 and shape[i] >= axis_size:
             spec = [None] * len(shape)
             spec[i] = axis
