@@ -1,9 +1,10 @@
-"""Expert parallelism — top-1 MoE dispatch with all_to_all over an
-``ep`` mesh axis.
+"""Expert parallelism — MoE dispatch with all_to_all over an ``ep``
+mesh axis: top-1 serving dispatch (``make_moe_layer``) and a trainable
+differentiable top-k layer (``make_moe_train_layer``).
 
 Completes the parallelism inventory (dp/FSDP, sp ring attention, pp
 pipeline, federated nodes — and now ep). One expert per device: each
-device routes its local tokens (top-1), packs up to ``capacity`` tokens
+device routes its local tokens, packs up to ``capacity`` tokens
 per destination expert into a static [n, C, D] dispatch buffer,
 ``all_to_all`` swaps buffers so every device receives its expert's
 tokens from all peers, the local expert MLP runs, and a second
@@ -11,12 +12,18 @@ tokens from all peers, the local expert MLP runs, and a second
 them back into token order. Over-capacity tokens pass through on the
 residual path (standard Switch-style dropping).
 
+Training (``make_moe_train_layer``): a learnable softmax router picks
+top-k experts; the combine is weighted by renormalized router
+probabilities so the router gets gradients, and a Switch-Transformer
+auxiliary load-balance loss keeps expert traffic even.
+
 Static shapes throughout — routing is data-dependent but expressed as
 argsort/segment ops, never shape-changing, so the whole layer jits.
 """
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Any, Callable
 
 import jax
@@ -24,17 +31,19 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 
-def moe_dispatch(
+def _dispatch(
     x: jnp.ndarray,
     expert_of: jnp.ndarray,
     expert_fn: Callable[[jnp.ndarray], jnp.ndarray],
     capacity: int,
     axis_name: str = "ep",
-) -> jnp.ndarray:
-    """Run inside shard_map. ``x``: local tokens [T, D]; ``expert_of``:
-    [T] int32 — ids in [0, n) dispatch, anything else (e.g. -1) means
-    "drop". Returns [T, D]: expert outputs for dispatched tokens, the
-    token itself (residual passthrough) for dropped/over-capacity ones."""
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """One all_to_all dispatch/return pass. ``x``: local tokens [T, D];
+    ``expert_of``: [T] int32 — ids in [0, n) dispatch, anything else
+    (e.g. -1) means "drop". Returns ``(out [T, D], keep [T] bool)``:
+    expert outputs where kept; out rows for dropped/over-capacity
+    tokens are zero. Indices are integer (no gradient); gradients flow
+    through the token values and the expert computation."""
     n = jax.lax.psum(1, axis_name)
     t, d = x.shape
 
@@ -67,7 +76,149 @@ def moe_dispatch(
         out, axis_name, split_axis=0, concat_axis=0, tiled=False
     )
     gathered = returned[slot_e, slot_c]  # [T, D]
-    return jnp.where(keep[:, None], gathered, x)
+    return jnp.where(keep[:, None], gathered, 0.0), keep
+
+
+def moe_dispatch(
+    x: jnp.ndarray,
+    expert_of: jnp.ndarray,
+    expert_fn: Callable[[jnp.ndarray], jnp.ndarray],
+    capacity: int,
+    axis_name: str = "ep",
+) -> jnp.ndarray:
+    """Top-1 dispatch with residual passthrough: expert outputs for
+    dispatched tokens, the token itself for dropped/over-capacity ones
+    (standard Switch-style dropping)."""
+    out, keep = _dispatch(x, expert_of, expert_fn, capacity, axis_name)
+    return jnp.where(keep[:, None], out, x)
+
+
+def moe_forward_topk(
+    router_w: jnp.ndarray,
+    expert_params: Any,
+    x: jnp.ndarray,
+    expert_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    capacity: int,
+    k: int = 2,
+    axis_name: str = "ep",
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Run inside shard_map: differentiable top-k MoE for TRAINING.
+
+    ``router_w`` [D, n] (replicated), ``expert_params`` stacked with
+    this device's expert at index 0 after sharding, ``x`` local tokens
+    [T, D]. Returns ``(y [T, D], aux_loss scalar)``.
+
+    - Routing: softmax over router logits; ``lax.top_k`` picks k
+      experts per token; combine weights are the renormalized top-k
+      probabilities, so the router receives gradients through the
+      weighted combine (the standard top-k MoE estimator — dispatch
+      indices themselves are integers and carry none).
+    - Unprocessed probability mass (dropped/over-capacity choices)
+      falls back to the residual path: y includes (1 - kept mass) * x,
+      keeping the layer smooth as capacity bites.
+    - ``aux_loss``: Switch-Transformer load-balance loss, n * sum_e
+      (token fraction routed to e) * (mean router prob of e), pmean'd
+      over the axis — minimized (= 1) at a uniform expert load.
+    """
+    n = jax.lax.psum(1, axis_name)
+    my_params = jax.tree_util.tree_map(lambda p: p[0], expert_params)
+    logits = x @ router_w  # [T, n]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    top_p, top_e = jax.lax.top_k(probs, k)  # [T, k]
+    gate = top_p / jnp.maximum(top_p.sum(-1, keepdims=True), 1e-9)
+
+    y = jnp.zeros_like(x)
+    kept_mass = jnp.zeros((x.shape[0],), x.dtype)
+    # k dispatch passes, each with its own capacity-C buffer (capacity
+    # is counted per choice rank, not jointly — document at call site).
+    for j in range(k):
+        out_j, keep_j = _dispatch(
+            x,
+            top_e[:, j],
+            lambda toks: expert_fn(my_params, toks),
+            capacity,
+            axis_name,
+        )
+        w_j = gate[:, j].astype(x.dtype) * keep_j.astype(x.dtype)
+        y = y + w_j[:, None] * out_j
+        kept_mass = kept_mass + w_j
+    y = y + (1.0 - kept_mass)[:, None] * x
+
+    # Load-balance: fraction of tokens whose TOP choice is e, times the
+    # mean router probability of e (Shazeer/Fedus et al.).
+    f = jnp.mean(jax.nn.one_hot(top_e[:, 0], n, dtype=jnp.float32), axis=0)
+    p_mean = jnp.mean(probs, axis=0)
+    f = jax.lax.pmean(f, axis_name)
+    p_mean = jax.lax.pmean(p_mean, axis_name)
+    aux_loss = n * jnp.sum(f * p_mean)
+    return y, aux_loss
+
+
+def make_moe_train_layer(
+    mesh: Mesh,
+    expert_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    capacity: int,
+    k: int = 2,
+    axis_name: str = "ep",
+):
+    """Trainable expert-parallel layer over ``mesh[axis_name]``.
+
+    Returns ``apply(params, tokens) -> (y, aux_loss)`` (jitted), where
+    ``params = {"router": [D, n_experts], "experts": stacked expert
+    params [n_experts, ...]}``. Differentiable end-to-end: router
+    gradients flow through the top-k combine weights, expert gradients
+    through the dispatched tokens, and ``aux_loss`` (add it to the task
+    loss scaled by ~1e-2) pushes the router toward balanced expert
+    load. Capacity is per choice rank (k buffers of ``capacity``), not
+    a joint budget."""
+    n = mesh.shape[axis_name]
+    param_spec = PartitionSpec(axis_name)
+    tok_spec = PartitionSpec(axis_name)
+
+    fn = jax.shard_map(
+        partial(
+            _train_local,
+            expert_fn=expert_fn,
+            capacity=capacity,
+            k=k,
+            axis_name=axis_name,
+        ),
+        mesh=mesh,
+        in_specs=(PartitionSpec(), param_spec, tok_spec),
+        out_specs=(tok_spec, PartitionSpec()),
+        check_vma=False,
+    )
+
+    def apply(params: Any, tokens: jnp.ndarray):
+        experts = params["experts"]
+        for leaf in jax.tree_util.tree_leaves(experts):
+            if leaf.shape[0] != n:
+                raise ValueError(
+                    f"Expert param leading dim {leaf.shape[0]} != mesh "
+                    f"axis {axis_name}={n} (one expert per device)"
+                )
+        router = params["router"]
+        if router.shape[-1] != n:
+            raise ValueError(
+                f"Router output dim {router.shape[-1]} != n_experts {n}"
+            )
+        experts = jax.tree_util.tree_map(
+            lambda p: jax.device_put(p, NamedSharding(mesh, param_spec)),
+            experts,
+        )
+        return fn(
+            router,
+            experts,
+            jax.device_put(tokens, NamedSharding(mesh, tok_spec)),
+        )
+
+    return jax.jit(apply)
+
+
+def _train_local(router_w, expert_params, x, *, expert_fn, capacity, k, axis_name):
+    return moe_forward_topk(
+        router_w, expert_params, x, expert_fn, capacity, k, axis_name
+    )
 
 
 def make_moe_layer(
