@@ -1,0 +1,140 @@
+"""Torch weight-interop tests — import a torch ``state_dict`` into the
+tpfl flax models and back. The parity target is the reference example
+MLP (``/root/reference/p2pfl/learning/frameworks/pytorch/lightning_model.py:118``:
+Linear 784-256-128-10) — importing its weights must reproduce the torch
+forward exactly."""
+
+import numpy as np
+import pytest
+import torch
+
+from tpfl.interop import from_torch_state_dict, to_torch_state_dict
+
+
+def _torch_mlp(seed=0):
+    torch.manual_seed(seed)
+    return torch.nn.Sequential(
+        torch.nn.Linear(784, 256),
+        torch.nn.ReLU(),
+        torch.nn.Linear(256, 128),
+        torch.nn.ReLU(),
+        torch.nn.Linear(128, 10),
+    )
+
+
+def test_torch_mlp_import_forward_parity():
+    import jax.numpy as jnp
+
+    from tpfl.models import MLP, create_model
+
+    tm = _torch_mlp()
+    model = create_model(
+        "mlp", (28, 28), seed=0, hidden_sizes=(256, 128),
+        compute_dtype=jnp.float32,
+    )
+    params = from_torch_state_dict(model.get_parameters(), tm.state_dict())
+
+    x = np.random.default_rng(0).normal(size=(4, 784)).astype(np.float32)
+    with torch.no_grad():
+        want = tm(torch.as_tensor(x)).numpy()
+    got = MLP(hidden_sizes=(256, 128), compute_dtype=jnp.float32).apply(
+        {"params": params}, jnp.asarray(x.reshape(4, 28, 28))
+    )
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-5)
+
+
+def test_torch_state_dict_round_trip():
+    import jax.numpy as jnp
+
+    from tpfl.models import create_model
+
+    tm = _torch_mlp(seed=3)
+    sd = tm.state_dict()
+    model = create_model(
+        "mlp", (28, 28), seed=0, hidden_sizes=(256, 128),
+        compute_dtype=jnp.float32,
+    )
+    params = from_torch_state_dict(model.get_parameters(), sd)
+    back = to_torch_state_dict(params, sd)
+    assert list(back) == list(sd)
+    for k in sd:
+        np.testing.assert_allclose(back[k], sd[k].numpy(), atol=0)
+
+
+def test_torch_conv_bn_import():
+    """Conv OIHW->HWIO transposition + BatchNorm running stats into the
+    batch_stats collection."""
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    class TinyConvNet(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = nn.Conv(8, (3, 3), use_bias=True)(x)
+            x = nn.BatchNorm(use_running_average=not train)(x)
+            return nn.relu(x)
+
+    torch.manual_seed(1)
+    tnet = torch.nn.Sequential(
+        torch.nn.Conv2d(3, 8, 3, padding=1),
+        torch.nn.BatchNorm2d(8),
+        torch.nn.ReLU(),
+    )
+    # Make running stats non-trivial.
+    tnet.train()
+    with torch.no_grad():
+        tnet(torch.randn(16, 3, 8, 8))
+    tnet.eval()
+
+    module = TinyConvNet()
+    variables = module.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 3)), train=False
+    )
+    aux = {k: v for k, v in variables.items() if k != "params"}
+    params, new_aux = from_torch_state_dict(
+        variables["params"], tnet.state_dict(), aux=aux
+    )
+
+    x = np.random.default_rng(1).normal(size=(4, 8, 8, 3)).astype(np.float32)
+    with torch.no_grad():
+        # torch is NCHW; transpose data in, features out.
+        want = (
+            tnet(torch.as_tensor(x.transpose(0, 3, 1, 2)))
+            .permute(0, 2, 3, 1)
+            .numpy()
+        )
+    got = module.apply({"params": params, **new_aux}, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-4)
+    # Running stats really arrived.
+    np.testing.assert_allclose(
+        np.asarray(new_aux["batch_stats"]["BatchNorm_0"]["mean"]),
+        tnet[1].running_mean.numpy(),
+        atol=1e-6,
+    )
+
+
+def test_mismatch_raises():
+    import jax.numpy as jnp
+
+    from tpfl.models import create_model
+
+    model = create_model(
+        "mlp", (28, 28), seed=0, hidden_sizes=(256, 128),
+        compute_dtype=jnp.float32,
+    )
+    # Wrong hidden width.
+    torch.manual_seed(0)
+    bad = torch.nn.Sequential(torch.nn.Linear(784, 64), torch.nn.Linear(64, 10))
+    with pytest.raises(ValueError, match="module count|does not map"):
+        from_torch_state_dict(model.get_parameters(), bad.state_dict())
+    # Extra module.
+    torch.manual_seed(0)
+    extra = torch.nn.Sequential(
+        torch.nn.Linear(784, 256),
+        torch.nn.Linear(256, 128),
+        torch.nn.Linear(128, 10),
+        torch.nn.Linear(10, 10),
+    )
+    with pytest.raises(ValueError, match="module count"):
+        from_torch_state_dict(model.get_parameters(), extra.state_dict())

@@ -25,3 +25,7 @@ from tpfl.settings import Settings
 __version__ = "0.1.0"
 
 __all__ = ["Settings", "__version__"]
+
+# tpfl.interop (torch state_dict bridge) is import-on-demand: it pulls
+# in nothing beyond numpy/jax, but keeping it out of the root import
+# keeps `import tpfl` lean.

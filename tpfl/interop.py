@@ -1,0 +1,263 @@
+"""Torch weight interop — state_dict <-> flax params bridge.
+
+The reference accepts PyTorch/Keras/Flax models through its learner
+factory (``/root/reference/p2pfl/learning/frameworks/learner_factory.py:29-57``);
+tpfl is deliberately JAX-only (SURVEY §7), so interop happens at the
+WEIGHT level instead: import a trained torch ``state_dict`` into a tpfl
+flax model (or export back) for direct head-to-head accuracy comparison
+with the PyTorch reference. No torch training, no torch dependency at
+module import — tensors are converted via ``numpy``.
+
+Conversion rules (the standard torch<->flax layout mapping):
+- ``Linear.weight`` [out, in]   <-> ``Dense.kernel`` [in, out] (transpose)
+- ``Conv2d.weight`` [O, I, H, W] <-> ``Conv.kernel`` [H, W, I, O]
+- ``weight``/``bias`` of norm layers <-> ``scale``/``bias`` (1-D, as-is)
+- ``running_mean``/``running_var``  <-> ``batch_stats`` ``mean``/``var``
+- ``num_batches_tracked`` is dropped (flax keeps no step counter)
+
+Alignment is by MODULE ORDER, not by name: both sides are grouped into
+per-module leaf dicts (torch by key prefix in insertion order, flax by
+tree iteration order — ``Dense_10`` after ``Dense_9``), then zipped.
+This matches any torch module whose layer order equals the flax
+definition order, including the reference MLP
+(``lightning_model.py:118``: Linear 784-256-128-10).
+
+Caveat: a ``Linear`` that directly consumes a flattened conv feature
+map is NOT mechanically convertible — torch flattens C,H,W while flax
+flattens H,W,C, so that one kernel's input dimension needs a manual
+permutation. MLPs on flat inputs and conv stacks up to (and including)
+global pooling convert exactly.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Mapping, Optional
+
+import jax
+import numpy as np
+
+_TORCH_SKIP = ("num_batches_tracked",)
+_RUNNING = ("running_mean", "running_var")
+
+
+def _to_numpy(t: Any) -> np.ndarray:
+    if hasattr(t, "detach"):  # torch tensor, no torch import needed
+        t = t.detach().cpu().numpy()
+    return np.asarray(t)
+
+
+def _natural_sorted(keys: list) -> list:
+    def key_of(k):
+        return [
+            int(tok) if tok.isdigit() else tok
+            for tok in re.split(r"(\d+)", str(k))
+            if tok != ""
+        ]
+
+    return sorted(keys, key=key_of)
+
+
+def _flax_groups(params: Any) -> list[tuple[tuple, dict[str, Any]]]:
+    """[(module_path, {leaf_name: array})] — depth-first in dict
+    ITERATION order, which for params fresh from ``module.init`` (or a
+    ``TpflModel``) is the module definition order; that is the order
+    torch's ``state_dict`` uses too. If a dict's keys look
+    alphabetically sorted (a pytree that went through jax tree ops,
+    which rebuild dicts key-sorted), same-prefix numeric suffixes are
+    re-sorted naturally so ``Dense_10`` follows ``Dense_9``; mixed
+    module types in a key-sorted tree cannot be re-ordered and the
+    module-count/shape checks will catch any misalignment."""
+    groups: list[tuple[tuple, dict[str, Any]]] = []
+
+    def walk(node: Mapping, path: tuple) -> None:
+        keys = list(node.keys())
+        if keys == sorted(map(str, keys)):
+            keys = _natural_sorted(keys)
+        leaf_items = {
+            k: node[k] for k in keys if not isinstance(node[k], Mapping)
+        }
+        if leaf_items:
+            groups.append((path, leaf_items))
+        for k in keys:
+            if isinstance(node[k], Mapping):
+                walk(node[k], path + (str(k),))
+
+    walk(params, ())
+    return groups
+
+
+def _torch_groups(
+    state_dict: Mapping[str, Any],
+) -> list[tuple[str, dict[str, np.ndarray]]]:
+    """[(module_prefix, {leaf_name: array})] in insertion order, skipping
+    bookkeeping entries."""
+    groups: dict[str, dict[str, np.ndarray]] = {}
+    for key, val in state_dict.items():
+        prefix, _, leaf = key.rpartition(".")
+        if leaf in _TORCH_SKIP:
+            continue
+        groups.setdefault(prefix, {})[leaf] = _to_numpy(val)
+    return list(groups.items())
+
+
+def _import_leaf(torch_name: str, arr: np.ndarray, flax_name: str,
+                 target: Any) -> np.ndarray:
+    want = np.shape(target)
+    if torch_name == "weight" and flax_name == "kernel":
+        if arr.ndim == 2:
+            arr = arr.T
+        elif arr.ndim == 4:  # OIHW -> HWIO
+            arr = arr.transpose(2, 3, 1, 0)
+        elif arr.ndim == 3:  # Conv1d OIW -> WIO
+            arr = arr.transpose(2, 1, 0)
+    if arr.shape != want:
+        raise ValueError(
+            f"torch '{torch_name}' {arr.shape} does not map onto flax "
+            f"'{flax_name}' {want}"
+        )
+    return arr.astype(np.asarray(target).dtype)
+
+
+def _match_names(torch_leaves: dict, flax_leaves: dict) -> list[tuple[str, str]]:
+    """Pair torch leaf names with flax leaf names within one module."""
+    pairs = []
+    for tname in torch_leaves:
+        if tname == "weight":
+            fname = "kernel" if "kernel" in flax_leaves else "scale"
+        elif tname == "running_mean":
+            fname = "mean"
+        elif tname == "running_var":
+            fname = "var"
+        else:
+            fname = tname
+        if fname not in flax_leaves:
+            raise ValueError(
+                f"torch leaf '{tname}' has no flax counterpart among "
+                f"{sorted(flax_leaves)}"
+            )
+        pairs.append((tname, fname))
+    return pairs
+
+
+def from_torch_state_dict(
+    params: Any,
+    state_dict: Mapping[str, Any],
+    aux: Optional[Any] = None,
+) -> Any:
+    """Fill a flax params pytree from a torch ``state_dict``.
+
+    ``params`` provides the target structure/shapes/dtypes; values are
+    replaced by the converted torch tensors. With ``aux`` (a
+    ``{"batch_stats": ...}`` collection), BatchNorm running stats are
+    imported too and ``(params, aux)`` is returned; otherwise just the
+    new params. Raises on any module-count, name or shape mismatch —
+    silent misalignment would corrupt every layer after it.
+    """
+    stats_target = aux["batch_stats"] if aux is not None else None
+    fgroups = _flax_groups(params)
+    sgroups = _flax_groups(stats_target) if stats_target is not None else []
+    tgroups = _torch_groups(state_dict)
+
+    # Split torch groups' running stats out; they align with the
+    # batch_stats tree, the rest with params.
+    t_param_groups: list[tuple[str, dict]] = []
+    t_stat_groups: list[tuple[str, dict]] = []
+    for prefix, leaves in tgroups:
+        pleaves = {k: v for k, v in leaves.items() if k not in _RUNNING}
+        sleaves = {k: v for k, v in leaves.items() if k in _RUNNING}
+        if pleaves:
+            t_param_groups.append((prefix, pleaves))
+        if sleaves:
+            t_stat_groups.append((prefix, sleaves))
+
+    if len(t_param_groups) != len(fgroups):
+        raise ValueError(
+            f"module count mismatch: torch has {len(t_param_groups)} "
+            f"parameterized modules, flax params has {len(fgroups)}"
+        )
+    if stats_target is not None and len(t_stat_groups) != len(sgroups):
+        raise ValueError(
+            f"BatchNorm count mismatch: torch has {len(t_stat_groups)} "
+            f"modules with running stats, batch_stats has {len(sgroups)}"
+        )
+
+    def fill(target_tree, fg, tg):
+        updates: dict[tuple, np.ndarray] = {}
+        for (fpath, fleaves), (_tprefix, tleaves) in zip(fg, tg):
+            for tname, fname in _match_names(tleaves, fleaves):
+                updates[fpath + (fname,)] = _import_leaf(
+                    tname, tleaves[tname], fname, fleaves[fname]
+                )
+
+        def replace(path, leaf):
+            key = tuple(getattr(p, "key", str(p)) for p in path)
+            return jax.numpy.asarray(updates.get(key, leaf))
+
+        return jax.tree_util.tree_map_with_path(replace, target_tree)
+
+    new_params = fill(params, fgroups, t_param_groups)
+    if stats_target is None:
+        return new_params
+    new_stats = fill(stats_target, sgroups, t_stat_groups)
+    new_aux = dict(aux)
+    new_aux["batch_stats"] = new_stats
+    return new_params, new_aux
+
+
+def to_torch_state_dict(
+    params: Any,
+    template: Mapping[str, Any],
+    aux: Optional[Any] = None,
+) -> dict[str, np.ndarray]:
+    """Export flax params into a torch-shaped state_dict.
+
+    ``template`` (an existing state_dict, or any mapping with the same
+    keys — values may be tensors or shapes) fixes the key names and
+    order; returned values are numpy arrays ready for
+    ``module.load_state_dict`` after ``torch.as_tensor``. The inverse of
+    :func:`from_torch_state_dict` (round-trip tested)."""
+    fgroups = _flax_groups(params)
+    stats_target = aux["batch_stats"] if aux is not None else None
+    sgroups = _flax_groups(stats_target) if stats_target is not None else []
+    tgroups = _torch_groups(template)
+
+    out: dict[str, np.ndarray] = {}
+    fi = si = 0
+    for prefix, tleaves in tgroups:
+        pnames = [n for n in tleaves if n not in _RUNNING]
+        snames = [n for n in tleaves if n in _RUNNING]
+        if pnames:
+            if fi >= len(fgroups):
+                raise ValueError("template has more modules than params")
+            _, fleaves = fgroups[fi]
+            fi += 1
+            for tname, fname in _match_names(
+                {n: tleaves[n] for n in pnames}, fleaves
+            ):
+                arr = np.asarray(fleaves[fname])
+                if tname == "weight" and fname == "kernel":
+                    if arr.ndim == 2:
+                        arr = arr.T
+                    elif arr.ndim == 4:  # HWIO -> OIHW
+                        arr = arr.transpose(3, 2, 0, 1)
+                    elif arr.ndim == 3:  # WIO -> OIW
+                        arr = arr.transpose(2, 1, 0)
+                key = f"{prefix}.{tname}" if prefix else tname
+                out[key] = arr
+        if snames:
+            if stats_target is None:
+                raise ValueError(
+                    f"template expects running stats under '{prefix}' but "
+                    f"no aux/batch_stats was given"
+                )
+            if si >= len(sgroups):
+                raise ValueError("template has more stat modules than aux")
+            _, sleaves = sgroups[si]
+            si += 1
+            for tname, fname in _match_names(
+                {n: tleaves[n] for n in snames}, sleaves
+            ):
+                key = f"{prefix}.{tname}" if prefix else tname
+                out[key] = np.asarray(sleaves[fname])
+    return out

@@ -13,9 +13,14 @@ running (acc, max, denom) between key blocks; the first key block
 initializes them and the last one writes the output block. Causal
 programs above the diagonal skip all work via ``pl.when``.
 
+Training: ``flash_attention`` carries a ``jax.custom_vjp`` with the
+standard recompute-based flash backward (Dao et al.): the forward
+additionally banks the per-query logsumexp L; the backward recomputes
+P = exp(S - L) tile by tile and runs two kernels — dQ (query-block
+grid, key sweep) and dK/dV (key-block grid, query sweep) — all matmuls
+on the MXU, no S-sized tensor ever materialized in HBM.
+
 ``flash_attention`` interprets on CPU (tests) and compiles on TPU.
-Forward-only (no custom VJP): it is the inference/serving fast path —
-training uses the differentiable XLA blockwise path.
 """
 
 from __future__ import annotations
@@ -30,8 +35,8 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30  # large-negative instead of -inf: exp() stays exact, no NaNs
 
 
-def _kernel(
-    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
     *, block: int, causal: bool, scale: float,
 ):
     qi = pl.program_id(1)
@@ -77,6 +82,218 @@ def _kernel(
     def _finalize():
         denom = jnp.maximum(l_ref[:, :1], 1e-30)
         o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+        # Per-query logsumexp (the flash backward's softmax residual).
+        lse_ref[0] = (m_ref[:, 0] + jnp.log(jnp.maximum(l_ref[:, 0], 1e-30)))
+
+
+def _dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dq_ref, dq_acc_ref,
+    *, block: int, causal: bool, scale: float,
+):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc_ref[:] = jnp.zeros_like(dq_acc_ref)
+
+    run = (qi >= ki) if causal else (ki >= 0)
+
+    @pl.when(run)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k_j = k_ref[0].astype(jnp.float32)
+        v_j = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_j, (((1,), (1,)), ((), ())))
+        if causal:
+            q_pos = qi * block + jax.lax.broadcasted_iota(
+                jnp.int32, (block, block), 0
+            )
+            k_pos = ki * block + jax.lax.broadcasted_iota(
+                jnp.int32, (block, block), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0][:, None])  # [blkq, blkk]
+        dp = jax.lax.dot_general(do, v_j, (((1,), (1,)), ((), ())))
+        ds = p * (dp - dd_ref[0][:, None])
+        dq_acc_ref[:] += jax.lax.dot_general(
+            ds, k_j, (((1,), (0,)), ((), ()))
+        )
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = (dq_acc_ref[:] * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dk_ref, dv_ref,
+    dk_acc_ref, dv_acc_ref,
+    *, block: int, causal: bool, scale: float,
+):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
+
+    run = (qi >= ki) if causal else (qi >= 0)
+
+    @pl.when(run)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k_j = k_ref[0].astype(jnp.float32)
+        v_j = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_j, (((1,), (1,)), ((), ())))
+        if causal:
+            q_pos = qi * block + jax.lax.broadcasted_iota(
+                jnp.int32, (block, block), 0
+            )
+            k_pos = ki * block + jax.lax.broadcasted_iota(
+                jnp.int32, (block, block), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0][:, None])  # [blkq, blkk]
+        # dV_j += P^T @ dO
+        dv_acc_ref[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ()))
+        )
+        dp = jax.lax.dot_general(do, v_j, (((1,), (1,)), ((), ())))
+        ds = p * (dp - dd_ref[0][:, None])
+        # dK_j += dS^T @ (Q * scale)  (scale already folded into q)
+        dk_acc_ref[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ()))
+        )
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc_ref[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[:].astype(dv_ref.dtype)
+
+
+def _prep(x, b, h, s, d, s_pad, d_pad):
+    x = jnp.moveaxis(x, 2, 1).reshape(b * h, s, d)  # [BH, S, D]
+    return jnp.pad(x, ((0, 0), (0, s_pad - s), (0, d_pad - d)))
+
+
+def _unprep(x, b, h, s, d):
+    x = x[:, :s, :d].reshape(b, h, s, d)
+    return jnp.moveaxis(x, 1, 2)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal: bool, block: int, interpret: bool):
+    out, _ = _flash_fwd_impl(q, k, v, causal, block, interpret)
+    return out
+
+
+def _flash_fwd_impl(q, k, v, causal, block, interpret):
+    b, s, h, d = q.shape
+    blk = min(block, s)
+    s_pad = -(-s // blk) * blk
+    d_pad = -(-d // 128) * 128
+    qp = _prep(q, b, h, s, d, s_pad, d_pad)
+    kp = _prep(k, b, h, s, d, s_pad, d_pad)
+    vp = _prep(v, b, h, s, d, s_pad, d_pad)
+    nblk = s_pad // blk
+    out, lse = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, block=blk, causal=causal, scale=1.0 / (d**0.5)
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s_pad, d_pad), q.dtype),
+            jax.ShapeDtypeStruct((b * h, s_pad), jnp.float32),
+        ],
+        grid=(b * h, nblk, nblk),
+        in_specs=[
+            pl.BlockSpec((1, blk, d_pad), lambda bhi, qi, ki: (bhi, qi, 0)),
+            pl.BlockSpec((1, blk, d_pad), lambda bhi, qi, ki: (bhi, ki, 0)),
+            pl.BlockSpec((1, blk, d_pad), lambda bhi, qi, ki: (bhi, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, blk, d_pad), lambda bhi, qi, ki: (bhi, qi, 0)),
+            pl.BlockSpec((1, blk), lambda bhi, qi, ki: (bhi, qi)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((blk, d_pad), jnp.float32),  # acc
+            pltpu.VMEM((blk, 128), jnp.float32),  # running max (col 0)
+            pltpu.VMEM((blk, 128), jnp.float32),  # running denom (col 0)
+        ],
+        interpret=interpret,
+    )(qp, kp, vp)
+    return _unprep(out, b, h, s, d), lse
+
+
+def _flash_fwd(q, k, v, causal, block, interpret):
+    out, lse = _flash_fwd_impl(q, k, v, causal, block, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, block, interpret, res, dout):
+    q, k, v, out, lse = res
+    b, s, h, d = q.shape
+    blk = min(block, s)
+    s_pad = -(-s // blk) * blk
+    d_pad = -(-d // 128) * 128
+    nblk = s_pad // blk
+    scale = 1.0 / (d**0.5)
+
+    qp = _prep(q, b, h, s, d, s_pad, d_pad)
+    kp = _prep(k, b, h, s, d, s_pad, d_pad)
+    vp = _prep(v, b, h, s, d, s_pad, d_pad)
+    dop = _prep(dout, b, h, s, d, s_pad, d_pad)
+    op = _prep(out, b, h, s, d, s_pad, d_pad)
+    # D_i = rowsum(dO * O) — the softmax-derivative correction term.
+    dd = jnp.sum(dop.astype(jnp.float32) * op.astype(jnp.float32), axis=-1)
+    # lse pad rows: 0 is safe — their dO rows are zero, so every term
+    # they touch (p * 0, ds * 0) vanishes before it reaches real rows.
+
+    qkv_spec = pl.BlockSpec((1, blk, d_pad), lambda bhi, i, j: (bhi, i, 0))
+    kv_of_j = pl.BlockSpec((1, blk, d_pad), lambda bhi, i, j: (bhi, j, 0))
+    row_of_i = pl.BlockSpec((1, blk), lambda bhi, i, j: (bhi, i))
+    row_of_j = pl.BlockSpec((1, blk), lambda bhi, i, j: (bhi, j))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, block=blk, causal=causal, scale=scale),
+        out_shape=jax.ShapeDtypeStruct((b * h, s_pad, d_pad), q.dtype),
+        grid=(b * h, nblk, nblk),  # (BH, query block, key sweep)
+        in_specs=[qkv_spec, kv_of_j, kv_of_j, qkv_spec, row_of_i, row_of_i],
+        out_specs=qkv_spec,
+        scratch_shapes=[pltpu.VMEM((blk, d_pad), jnp.float32)],
+        interpret=interpret,
+    )(qp, kp, vp, dop, lse, dd)
+
+    q_of_j = pl.BlockSpec((1, blk, d_pad), lambda bhi, i, j: (bhi, j, 0))
+    kv_of_i = pl.BlockSpec((1, blk, d_pad), lambda bhi, i, j: (bhi, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, block=blk, causal=causal, scale=scale),
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s_pad, d_pad), k.dtype),
+            jax.ShapeDtypeStruct((b * h, s_pad, d_pad), v.dtype),
+        ],
+        grid=(b * h, nblk, nblk),  # (BH, key block, query sweep)
+        in_specs=[q_of_j, kv_of_i, kv_of_i, q_of_j, row_of_j, row_of_j],
+        out_specs=[kv_of_i, kv_of_i],
+        scratch_shapes=[
+            pltpu.VMEM((blk, d_pad), jnp.float32),
+            pltpu.VMEM((blk, d_pad), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp, dop, lse, dd)
+
+    return (
+        _unprep(dq, b, h, s, d),
+        _unprep(dk, b, h, s, d),
+        _unprep(dv, b, h, s, d),
+    )
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(
@@ -87,7 +304,9 @@ def flash_attention(
     block: int = 256,
     interpret: bool | None = None,
 ) -> jnp.ndarray:
-    """Pallas flash attention. q/k/v: [B, S, H, D] -> [B, S, H, D].
+    """Pallas flash attention, differentiable. q/k/v: [B, S, H, D] ->
+    [B, S, H, D]. Backward is the recompute-based flash VJP (two Pallas
+    kernels); gradients match the XLA blockwise path (tested).
 
     Non-causal with a sequence that doesn't divide ``block`` falls back
     to the XLA blockwise path (pad keys would need extra masking; the
@@ -101,32 +320,4 @@ def flash_attention(
         from tpfl.parallel.ring_attention import blockwise_attention
 
         return blockwise_attention(q, k, v, causal=False, block_size=blk)
-    d_pad = -(-d // 128) * 128
-
-    def prep(x):
-        x = jnp.moveaxis(x, 2, 1).reshape(b * h, s, d)  # [BH, S, D]
-        return jnp.pad(x, ((0, 0), (0, s_pad - s), (0, d_pad - d)))
-
-    qp, kp, vp = prep(q), prep(k), prep(v)
-    nblk = s_pad // blk
-    out = pl.pallas_call(
-        functools.partial(
-            _kernel, block=blk, causal=causal, scale=1.0 / (d**0.5)
-        ),
-        out_shape=jax.ShapeDtypeStruct((b * h, s_pad, d_pad), q.dtype),
-        grid=(b * h, nblk, nblk),
-        in_specs=[
-            pl.BlockSpec((1, blk, d_pad), lambda bhi, qi, ki: (bhi, qi, 0)),
-            pl.BlockSpec((1, blk, d_pad), lambda bhi, qi, ki: (bhi, ki, 0)),
-            pl.BlockSpec((1, blk, d_pad), lambda bhi, qi, ki: (bhi, ki, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, blk, d_pad), lambda bhi, qi, ki: (bhi, qi, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((blk, d_pad), jnp.float32),  # acc
-            pltpu.VMEM((blk, 128), jnp.float32),  # running max (col 0)
-            pltpu.VMEM((blk, 128), jnp.float32),  # running denom (col 0)
-        ],
-        interpret=interpret,
-    )(qp, kp, vp)
-    out = out[:, :s, :d].reshape(b, h, s, d)
-    return jnp.moveaxis(out, 1, 2)
+    return _flash(q, k, v, causal, block, interpret)
