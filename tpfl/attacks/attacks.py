@@ -36,7 +36,13 @@ def sign_flip() -> AttackFn:
 def additive_noise(std: float = 0.1, seed: int = 0) -> AttackFn:
     """Add ``N(0, std)`` Gaussian noise to every parameter (reference
     exp_SAVE3.txt:213-223). Deterministic per (seed, application
-    counter, leaf index) — two seeded runs poison identically."""
+    counter, leaf index) — two seeded runs poison identically PROVIDED
+    the returned AttackFn instance belongs to exactly one adversary:
+    the counter is closure state, so sharing one instance across
+    several adversaries (or calling it from multiple threads)
+    interleaves increments nondeterministically. Create one
+    ``additive_noise(...)`` per adversary (distinct ``seed`` per
+    adversary keeps their noise streams independent)."""
     counter = {"n": 0}
 
     def attack(params: Any) -> Any:

@@ -77,9 +77,9 @@ class Gossiper(threading.Thread):
         liveness traffic (heartbeats): it must not sit behind a vote /
         status burst at a relay hub, or peers evict each other while the
         queue drains. Two FIFO classes — priority drains first each
-        period, normal traffic gets the remaining budget, so neither
-        class can starve the other as long as liveness volume alone
-        stays under the per-period cap."""
+        period, but when BOTH queues are non-empty priority is capped at
+        half the per-period budget, so a relayed-heartbeat flood at a
+        large-N hub cannot starve votes/status indefinitely either."""
         with self._pending_lock:
             (self._priority if priority else self._pending).append(msg)
 
@@ -88,7 +88,12 @@ class Gossiper(threading.Thread):
             batch: list[Message] = []
             with self._pending_lock:
                 budget = Settings.GOSSIP_MESSAGES_PER_PERIOD
-                for _ in range(min(len(self._priority), budget)):
+                # Reserve half the budget for the normal class whenever
+                # it has traffic waiting (see add_message).
+                prio_budget = (
+                    budget if not self._pending else max(1, budget // 2)
+                )
+                for _ in range(min(len(self._priority), prio_budget)):
                     batch.append(self._priority.popleft())
                 for _ in range(
                     min(len(self._pending), budget - len(batch))

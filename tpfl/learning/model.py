@@ -85,7 +85,7 @@ class TpflModel:
             return
         if isinstance(params, bytes):
             decoded, contribs, n, info = serialization.decode_model_payload(params)
-            self._check_and_set(decoded)
+            self._check_and_set(decoded, restore_dtype=True)
             self._contributors = contribs
             self._num_samples = n
             self.additional_info.update(info)
@@ -103,7 +103,9 @@ class TpflModel:
             return
         self._check_and_set(params)
 
-    def _check_and_set(self, new_params: Pytree) -> None:
+    def _check_and_set(
+        self, new_params: Pytree, restore_dtype: bool = False
+    ) -> None:
         if self._params:
             old_leaves = jax.tree_util.tree_leaves(self._params)
             new_leaves = jax.tree_util.tree_leaves(new_params)
@@ -116,18 +118,21 @@ class TpflModel:
                     raise ModelNotMatchingError(
                         f"Shape mismatch: {np.shape(o)} vs {np.shape(n)}"
                     )
-            # Restore this model's own leaf dtypes: wire payloads may
-            # arrive downcast (Settings.WIRE_DTYPE) and the model's
-            # dtype contract must survive the round-trip.
-            treedef = jax.tree_util.tree_structure(self._params)
-            self._params = jax.tree_util.tree_unflatten(
-                treedef,
-                [
-                    jnp.asarray(n, jnp.asarray(o).dtype)
-                    for o, n in zip(old_leaves, new_leaves)
-                ],
-            )
-            return
+            if restore_dtype:
+                # Wire payloads arrive downcast (Settings.WIRE_DTYPE);
+                # the model's dtype contract must survive the
+                # round-trip. ONLY wire decodes take this path — a
+                # caller deliberately setting different-dtype params
+                # (f64 eval copy, dtype migration) keeps its dtypes.
+                treedef = jax.tree_util.tree_structure(self._params)
+                self._params = jax.tree_util.tree_unflatten(
+                    treedef,
+                    [
+                        jnp.asarray(n, jnp.asarray(o).dtype)
+                        for o, n in zip(old_leaves, new_leaves)
+                    ],
+                )
+                return
         self._params = jax.tree_util.tree_map(jnp.asarray, new_params)
 
     # --- serialization (msgpack, not pickle) ---

@@ -290,11 +290,24 @@ class TrainStage(Stage):
             except NoModelsToAggregateError:
                 # Deliberate empty-round case: no result to diffuse —
                 # finish the round instead of gossiping our local fit
-                # as if it were the aggregate.
+                # as if it were the aggregate. Still announce readiness:
+                # non-train-set peers in WaitAggregatedModelsStage would
+                # otherwise burn the whole AGGREGATION_TIMEOUT waiting
+                # for a model that is never coming.
                 logger.error(node.addr, "Nothing aggregated this round")
+                node.communication.broadcast(
+                    node.communication.build_msg(
+                        ModelsReadyCommand.name, [], round=st.round
+                    )
+                )
                 return RoundFinishedStage
             except Exception as e:  # byzantine/malformed peer payloads
                 logger.error(node.addr, f"Aggregation failed: {e}")
+                node.communication.broadcast(
+                    node.communication.build_msg(
+                        ModelsReadyCommand.name, [], round=st.round
+                    )
+                )
                 return RoundFinishedStage
             # A timed-out partial aggregate must not shadow the round's
             # authoritative full model if one arrived while the (possibly
