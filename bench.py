@@ -258,6 +258,98 @@ def main() -> None:
     except Exception as e:  # keep the primary metric alive
         extra["resnet18_cfg3_error"] = str(e)[:200]
 
+    # ---- long-context tier: flash kernel vs XLA blockwise, fwd+bwd ----
+    # The kernel must EARN its keep in training (custom VJP), so the
+    # comparison times gradient steps, not forwards.
+    try:
+        from tpfl.parallel.flash_kernel import flash_attention
+        from tpfl.parallel.ring_attention import blockwise_attention
+
+        def time_attn(fn, S, n_iters=5):
+            B, H, D = 1, 8, 128
+            rng = np.random.default_rng(0)
+            q, k, v = (
+                jnp.asarray(
+                    rng.normal(size=(B, S, H, D)), jnp.bfloat16
+                )
+                for _ in range(3)
+            )
+
+            def loss(q, k, v):
+                return jnp.sum(
+                    fn(q, k, v, causal=True).astype(jnp.float32) ** 2
+                )
+
+            g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+            jax.block_until_ready(g(q, k, v))  # compile
+            t0 = time.perf_counter()
+            for _ in range(n_iters):
+                out = g(q, k, v)
+            jax.block_until_ready(out)
+            return B * S * n_iters / (time.perf_counter() - t0)
+
+        for S in (8192, 32768):
+            extra[f"flash_fwdbwd_{S//1024}k_toks_per_sec"] = round(
+                time_attn(flash_attention, S), 1
+            )
+            extra[f"blockwise_fwdbwd_{S//1024}k_toks_per_sec"] = round(
+                time_attn(
+                    lambda q, k, v, causal: blockwise_attention(
+                        q, k, v, causal=causal
+                    ),
+                    S,
+                ),
+                1,
+            )
+    except Exception as e:
+        extra["flash_attn_error"] = str(e)[:200]
+
+    # ---- transformer_sp tier: TransformerLM training at 32k tokens ----
+    try:
+        from tpfl.models import TransformerLM
+        from tpfl.parallel.flash_kernel import flash_attention as _fa
+
+        S_lm = 32768
+        lm = TransformerLM(
+            vocab=256, dim=512, heads=8, n_layers=4, max_len=S_lm,
+            attention_fn=_fa,
+        )
+        rng = np.random.default_rng(0)
+        toks = jnp.asarray(
+            rng.integers(0, 256, (1, S_lm)), jnp.int32
+        )
+        variables = lm.init(jax.random.PRNGKey(0), toks[:, :128], train=False)
+        import optax
+
+        tx = optax.sgd(1e-2, momentum=0.9)
+        lm_params = variables["params"]
+        lm_opt = tx.init(lm_params)
+
+        @jax.jit
+        def lm_step(p, o, t):
+            def loss_of(pp):
+                logits = lm.apply({"params": pp}, t, train=True)
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits[:, :-1], t[:, 1:]
+                ).mean()
+
+            loss, grads = jax.value_and_grad(loss_of)(p)
+            upd, o = tx.update(grads, o, p)
+            return optax.apply_updates(p, upd), o, loss
+
+        lm_params, lm_opt, l0 = lm_step(lm_params, lm_opt, toks)
+        float(l0)  # compile+sync
+        n_iters = 3
+        t0 = time.perf_counter()
+        for _ in range(n_iters):
+            lm_params, lm_opt, l0 = lm_step(lm_params, lm_opt, toks)
+        float(l0)
+        extra["transformer_32k_train_toks_per_sec"] = round(
+            S_lm * n_iters / (time.perf_counter() - t0), 1
+        )
+    except Exception as e:
+        extra["transformer_lm_error"] = str(e)[:200]
+
     # ---- config 4 tier: 1000 nodes, 10% partial participation ----
     try:
         n4, nb4, bs4 = 1000, 1, 32

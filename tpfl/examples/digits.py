@@ -101,6 +101,14 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
         "top cumulative entries (reference mnist.py --profiling uses "
         "yappi, unavailable here).",
     )
+    p.add_argument(
+        "--profile",
+        metavar="DIR",
+        default=None,
+        help="write a jax.profiler device trace of the whole experiment "
+        "to DIR (view with TensorBoard/xprof) — the TPU-native profiling "
+        "path; --profiling covers host-side Python instead.",
+    )
     args = p.parse_args(argv)
     args.topology = TopologyType(args.topology)
     return args
@@ -133,6 +141,15 @@ def _print_metric_tables() -> None:
 def digits(args: argparse.Namespace) -> list[Node]:
     """Build, connect, run and tear down the federation. Returns the
     (stopped) nodes so tests can inspect final models/metrics."""
+    if getattr(args, "profile", None):
+        import jax
+
+        with jax.profiler.trace(args.profile):
+            result = digits(
+                argparse.Namespace(**{**vars(args), "profile": None})
+            )
+        print(f"jax profiler trace written to {args.profile}")
+        return result
     start = time.time()
     Settings.set_standalone_settings()
 

@@ -14,11 +14,15 @@ Run: ``tpfl experiment run scale -- --nodes 100 --rounds 2`` (or
 rounds/sec at the end.
 
 Scale envelope: the protocol layer is Python threads, so its ceiling is
-host cores, not the TPU — a single-core host sustains ~200 nodes (vote
-floods cost O(N^2) relays/round through a star hub). For 1000-node
-federations use the vmapped path directly (bench.py's config-4 tier:
+host cores, not the TPU. A single STAR hub relays every flooded message
+to all N-1 peers (O(N^2) handler work at one node) and saturates around
+~200 nodes; the default TREE topology (star-of-stars, ~sqrt(N) fully
+meshed hubs — tpfl.utils.topologies) splits the relay load across hubs
+and sustains 500+ protocol nodes (measured: see README). Beyond that,
+use the vmapped path directly (bench.py's config-4 tier:
 ``VmapFederation`` with a participation mask — the whole round is one
-XLA program and the protocol overhead disappears).
+XLA program and the protocol overhead disappears) or the hierarchical
+``FederationLearner`` tier.
 """
 
 from __future__ import annotations
@@ -54,6 +58,13 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
     p.add_argument("--samples-per-node", type=int, default=64)
     p.add_argument("--batch-size", type=int, default=32)
     p.add_argument("--seed", type=int, default=666)
+    p.add_argument(
+        "--topology",
+        choices=["star", "tree"],
+        default="tree",
+        help="star = single hub (reference-style, ~200-node ceiling); "
+        "tree = sqrt(N) meshed hubs (default, 500+ nodes).",
+    )
     return p.parse_args(argv)
 
 
@@ -85,9 +96,13 @@ def scale(args: argparse.Namespace) -> dict[str, float]:
     for nd in nodes:
         nd.start()
     try:
-        # Star topology: hub connectivity scales O(N) (a FULL mesh of
-        # 1000 nodes would be ~500k in-process links).
-        matrix = TopologyFactory.generate_matrix(TopologyType.STAR, n)
+        # Hub-based topologies keep connectivity O(N) (a FULL mesh of
+        # 1000 nodes would be ~500k in-process links); TREE additionally
+        # spreads relay work over ~sqrt(N) hubs.
+        topo = (
+            TopologyType.TREE if args.topology == "tree" else TopologyType.STAR
+        )
+        matrix = TopologyFactory.generate_matrix(topo, n)
         TopologyFactory.connect_nodes(matrix, nodes)
         # Full-view discovery rides the heartbeat flood: every node must
         # hear N-1 others through the hub, so budget scales with N.

@@ -22,6 +22,7 @@ from tpfl.learning.dataset.rendered import (
 from tpfl.learning.dataset.synthetic import (
     synthetic_cifar10,
     synthetic_classification,
+    synthetic_lm,
     synthetic_mnist,
 )
 from tpfl.learning.dataset.tpfl_dataset import TpflDataset
@@ -38,6 +39,7 @@ __all__ = [
     "rendered_digits",
     "rendered_color_digits",
     "synthetic_mnist",
+    "synthetic_lm",
     "synthetic_cifar10",
     "synthetic_classification",
 ]

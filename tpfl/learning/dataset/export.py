@@ -113,17 +113,36 @@ class JaxExportStrategy(DataExportStrategy):
         flatten: bool = False,
         drop_remainder: bool = True,
         seed: int = 0,
+        x_dtype: Any = None,
         **kwargs: Any,
     ) -> Batches:
+        """``x_dtype``: feature dtype. Default None infers from the
+        column: integer features (token ids, TransformerLM) stay int32,
+        everything else becomes float32. ``scale`` only applies to
+        float features."""
         cols = ds.column_names
         if x_tag not in cols:
             # Fall back to the first non-label column.
-            candidates = [c for c in cols if c != y_tag]
+            candidates = [c for c in cols if c not in (y_tag, "targets")]
             if not candidates:
                 raise KeyError(f"No feature column found in {cols}")
             x_tag = candidates[0]
-        x = np.asarray(ds[x_tag], dtype=np.float32)
-        if scale != 1.0:
+        if y_tag not in cols:
+            # Token datasets name their labels "targets"; else take the
+            # last column that isn't the feature.
+            y_candidates = [c for c in cols if c != x_tag]
+            if not y_candidates:
+                raise KeyError(f"No label column found in {cols}")
+            y_tag = "targets" if "targets" in y_candidates else y_candidates[-1]
+        raw = np.asarray(ds[x_tag])
+        if x_dtype is None:
+            x_dtype = (
+                np.int32
+                if np.issubdtype(raw.dtype, np.integer)
+                else np.float32
+            )
+        x = raw.astype(x_dtype)
+        if scale != 1.0 and np.issubdtype(np.dtype(x_dtype), np.floating):
             x = x * scale
         if flatten and x.ndim > 2:
             x = x.reshape(len(x), -1)

@@ -45,6 +45,40 @@ def synthetic_classification(
     )
 
 
+def synthetic_lm(
+    seq_len: int = 64,
+    vocab: int = 32,
+    n_train: int = 256,
+    n_test: int = 64,
+    seed: int = 0,
+) -> TpflDataset:
+    """Learnable next-token data for TransformerLM tests: sequences
+    follow a fixed random permutation walk (token_{t+1} =
+    perm[token_t]) with occasional uniform noise, so a small causal LM
+    beats the uniform-loss floor quickly. Columns: ``tokens`` (int
+    features) / ``targets`` (one-step-shifted ids); export with
+    ``x_tag="tokens", y_tag="targets", x_dtype=np.int32``."""
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(vocab)
+
+    def make(n: int) -> tuple[np.ndarray, np.ndarray]:
+        seqs = np.empty((n, seq_len + 1), np.int32)
+        seqs[:, 0] = rng.integers(0, vocab, size=n)
+        for t in range(seq_len):
+            step = perm[seqs[:, t]]
+            noise = rng.random(n) < 0.1
+            seqs[:, t + 1] = np.where(
+                noise, rng.integers(0, vocab, size=n), step
+            )
+        return seqs[:, :-1], seqs[:, 1:].astype(np.int32)
+
+    x_tr, y_tr = make(n_train)
+    x_te, y_te = make(n_test)
+    return TpflDataset.from_arrays(
+        x_tr, y_tr, x_te, y_te, x_name="tokens", y_name="targets"
+    )
+
+
 def synthetic_mnist(
     n_train: int = 1000, n_test: int = 200, seed: int = 0, noise: float = 0.8
 ) -> TpflDataset:

@@ -250,25 +250,48 @@ class JaxLearner(Learner):
                 logits = module.apply(variables, x, train=False)
                 losses = loss_fn(logits, y)
                 preds = jnp.argmax(logits, -1)
+                # Sequence models produce per-token losses [b, S...];
+                # broadcast the per-sample mask to token granularity so
+                # the same program serves classifiers and LMs.
+                mm = jnp.broadcast_to(
+                    m.reshape(m.shape + (1,) * (losses.ndim - 1)),
+                    losses.shape,
+                )
                 cm = jnp.zeros((n_classes, n_classes), jnp.int32).at[
                     y, preds
-                ].add(m)
-                loss_sum, cm_sum = carry
-                return (loss_sum + jnp.sum(losses * m), cm_sum + cm), None
+                ].add(mm)
+                loss_sum, cm_sum, count = carry
+                return (
+                    loss_sum + jnp.sum(losses * mm),
+                    cm_sum + cm,
+                    count + jnp.sum(mm),
+                ), None
 
-            init = (jnp.zeros(()), jnp.zeros((n_classes, n_classes), jnp.int32))
-            (loss_sum, cm), _ = jax.lax.scan(one, init, (xs, ys, ms))
-            total = jnp.maximum(jnp.sum(ms), 1)
+            init = (
+                jnp.zeros(()),
+                jnp.zeros((n_classes, n_classes), jnp.int32),
+                jnp.zeros((), jnp.int32),
+            )
+            (loss_sum, cm, count), _ = jax.lax.scan(one, init, (xs, ys, ms))
+            total = jnp.maximum(count, 1)
             return loss_sum / total, cm
 
         return eval_batches
 
     # --- data ---
 
+    def _export_kwargs(self) -> dict:
+        """Token models (TransformerLM) declare ``input_dtype``; export
+        must keep integer ids integer instead of the float32 default."""
+        mod = self.get_model().module
+        dt = getattr(mod, "input_dtype", None)
+        return {"x_dtype": np.dtype(dt)} if dt is not None else {}
+
     def _train_data(self, epoch_seed: int):
         if self._train_batches is None:
             self._train_batches = self.get_data().export(
-                batch_size=self.batch_size, train=True, seed=epoch_seed
+                batch_size=self.batch_size, train=True, seed=epoch_seed,
+                **self._export_kwargs(),
             )
         return self._train_batches
 
@@ -425,7 +448,8 @@ class JaxLearner(Learner):
             return {}
         if self._eval_arrays is None:
             batches = data.export(
-                batch_size=self.batch_size, train=False, drop_remainder=False
+                batch_size=self.batch_size, train=False,
+                drop_remainder=False, **self._export_kwargs(),
             )
             # Pad to full batches with a sample mask so the compiled
             # shape is independent of the test-set size and no tail
@@ -438,22 +462,23 @@ class JaxLearner(Learner):
                 [np.ones(len(x), np.int32), np.zeros(pad, np.int32)]
             )
             x = np.concatenate([x, np.zeros((pad, *x.shape[1:]), x.dtype)])
-            y = np.concatenate([y, np.zeros(pad, y.dtype)])
+            y = np.concatenate([y, np.zeros((pad, *y.shape[1:]), y.dtype)])
             self._eval_arrays = (
                 x.reshape(n_batches, bs, *x.shape[1:]),
-                y.reshape(n_batches, bs),
+                y.reshape(n_batches, bs, *y.shape[1:]),
                 mask.reshape(n_batches, bs),
             )
         xs, ys, ms = self._eval_arrays
         if self._eval_fn is None:
             aux = model.aux_state or {}
+            in_dtype = getattr(self._module(), "input_dtype", jnp.float32)
             logits_shape = jax.eval_shape(
                 lambda p, a, xx: self._module().apply(
                     {"params": p, **a}, xx, train=False
                 ),
                 model.get_parameters(),
                 aux,
-                jnp.zeros(xs.shape[1:], jnp.float32),
+                jnp.zeros(xs.shape[1:], in_dtype),
             ).shape
             self._eval_fn = self._build_eval(int(logits_shape[-1]))
         loss, cm = self._eval_fn(
