@@ -289,18 +289,22 @@ def main() -> None:
             return B * S * n_iters / (time.perf_counter() - t0)
 
         for S in (8192, 32768):
-            extra[f"flash_fwdbwd_{S//1024}k_toks_per_sec"] = round(
-                time_attn(flash_attention, S), 1
-            )
-            extra[f"blockwise_fwdbwd_{S//1024}k_toks_per_sec"] = round(
-                time_attn(
+            for name, fn in (
+                ("flash", flash_attention),
+                (
+                    "blockwise",
                     lambda q, k, v, causal: blockwise_attention(
                         q, k, v, causal=causal
                     ),
-                    S,
                 ),
-                1,
-            )
+            ):
+                key = f"{name}_fwdbwd_{S//1024}k_toks_per_sec"
+                try:  # each measurement independent: the XLA blockwise
+                    # grad at 32k can exceed compiler limits; that must
+                    # not cost the kernel its numbers.
+                    extra[key] = round(time_attn(fn, S), 1)
+                except Exception as e:
+                    extra[key + "_error"] = str(e)[:160]
     except Exception as e:
         extra["flash_attn_error"] = str(e)[:200]
 

@@ -192,3 +192,68 @@ def test_chunking_respects_max_batch_nodes(monkeypatch):
     for t in threads:
         t.join(timeout=180)
     assert sorted(chunks) == [2, 3]
+
+
+def test_isolated_fit_matches_inline():
+    """Opt-in process isolation: the spawned-worker fit reproduces the
+    inline fit exactly (same export seed, same shuffle counters)."""
+    from tpfl.simulation import isolated
+
+    iso = make_learner("iso-twin", n=96, seed=5)
+    inline = make_learner("iso-twin", n=96, seed=5)
+    for ln in (iso, inline):
+        ln.set_epochs(1)
+    inline_model = inline.fit()
+    try:
+        fitted = isolated.isolated_fit(iso)
+    finally:
+        isolated.shutdown()
+    got = jax.tree_util.tree_leaves(fitted.get_parameters())
+    want = jax.tree_util.tree_leaves(inline_model.get_parameters())
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(w), rtol=2e-5, atol=2e-6
+        )
+    assert fitted.get_contributors() == ["iso-twin"]
+    assert fitted.get_num_samples() == inline_model.get_num_samples()
+
+
+def test_isolated_fit_contains_worker_crash():
+    """A worker that dies (native-crash stand-in: os._exit) fails ONLY
+    its own job; the executor is rebuilt and the next fit succeeds."""
+    import pickle
+
+    from tpfl.simulation import isolated
+
+    ln = make_learner("iso-crash", n=96, seed=6)
+    ln.set_epochs(1)
+    payload = isolated.extract_job(ln)
+    assert payload is not None
+    crash_job = pickle.loads(payload)
+    crash_job["_test_crash"] = True
+    try:
+        with pytest.raises(RuntimeError, match="worker died"):
+            isolated.isolated_fit(ln, pickle.dumps(crash_job))
+        # Pool self-heals: a fresh worker handles the next job.
+        fitted = isolated.isolated_fit(ln)
+        assert fitted is not None
+    finally:
+        isolated.shutdown()
+
+
+def test_isolation_scope_gates():
+    """Out-of-scope jobs (callbacks / custom optimizer) return None
+    from extract_job instead of silently dropping semantics."""
+    import optax
+
+    from tpfl.simulation import isolated
+
+    ln = make_learner("iso-scope", n=64)
+    assert isolated.extract_job(ln) is not None
+    custom = JaxLearner(
+        model=create_model("mlp", (28, 28), seed=3, hidden_sizes=(16,)),
+        data=synthetic_mnist(n_train=64, n_test=32, seed=0),
+        addr="iso-scope-2",
+        optimizer_factory=lambda lr: optax.sgd(lr),
+    )
+    assert isolated.extract_job(custom) is None

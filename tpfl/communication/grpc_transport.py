@@ -110,7 +110,9 @@ class GrpcCommunicationProtocol(ThreadedCommunicationProtocol):
             ),
         }
         self._server = grpc.server(
-            futures.ThreadPoolExecutor(max_workers=4),
+            futures.ThreadPoolExecutor(
+                max_workers=Settings.GRPC_SERVER_WORKERS
+            ),
             options=self._channel_options(),
         )
         self._server.add_generic_rpc_handlers(

@@ -9,6 +9,8 @@ benchmark ladder (MNIST MLP → CIFAR CNN → ResNet-18), with a
 stay float32.
 """
 
-from tpfl.models.zoo import CNN, MLP, ResNet18, create_model
+from tpfl.models.zoo import (CNN, MLP, ResNet18, TransformerBlock,
+                             TransformerLM, create_model)
 
-__all__ = ["MLP", "CNN", "ResNet18", "create_model"]
+__all__ = ["MLP", "CNN", "ResNet18", "TransformerBlock",
+           "TransformerLM", "create_model"]

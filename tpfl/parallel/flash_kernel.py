@@ -82,8 +82,12 @@ def _fwd_kernel(
     def _finalize():
         denom = jnp.maximum(l_ref[:, :1], 1e-30)
         o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
-        # Per-query logsumexp (the flash backward's softmax residual).
-        lse_ref[0] = (m_ref[:, 0] + jnp.log(jnp.maximum(l_ref[:, 0], 1e-30)))
+        # Per-query logsumexp (the flash backward's softmax residual),
+        # broadcast across the 8-lane trailing dim — mosaic requires
+        # block dims (8k, 128m) or dims equal to the array's, so scalar
+        # rows are stored 8 lanes wide (see _flash_fwd_impl).
+        lse = m_ref[:, :1] + jnp.log(jnp.maximum(l_ref[:, :1], 1e-30))
+        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
 def _dq_kernel(
@@ -115,9 +119,9 @@ def _dq_kernel(
                 jnp.int32, (block, block), 1
             )
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse_ref[0][:, None])  # [blkq, blkk]
+        p = jnp.exp(s - lse_ref[0][:, :1])  # [blkq, blkk]
         dp = jax.lax.dot_general(do, v_j, (((1,), (1,)), ((), ())))
-        ds = p * (dp - dd_ref[0][:, None])
+        ds = p * (dp - dd_ref[0][:, :1])
         dq_acc_ref[:] += jax.lax.dot_general(
             ds, k_j, (((1,), (0,)), ((), ()))
         )
@@ -158,13 +162,13 @@ def _dkv_kernel(
                 jnp.int32, (block, block), 1
             )
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse_ref[0][:, None])  # [blkq, blkk]
+        p = jnp.exp(s - lse_ref[0][:, :1])  # [blkq, blkk]
         # dV_j += P^T @ dO
         dv_acc_ref[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ()))
         )
         dp = jax.lax.dot_general(do, v_j, (((1,), (1,)), ((), ())))
-        ds = p * (dp - dd_ref[0][:, None])
+        ds = p * (dp - dd_ref[0][:, :1])
         # dK_j += dS^T @ (Q * scale)  (scale already folded into q)
         dk_acc_ref[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ()))
@@ -207,7 +211,10 @@ def _flash_fwd_impl(q, k, v, causal, block, interpret):
         ),
         out_shape=[
             jax.ShapeDtypeStruct((b * h, s_pad, d_pad), q.dtype),
-            jax.ShapeDtypeStruct((b * h, s_pad), jnp.float32),
+            # lse rows are stored 8 lanes wide (col 0 meaningful): a
+            # (1, blk) block of a 2-D array violates mosaic's (8, 128)
+            # tiling rule on real TPUs.
+            jax.ShapeDtypeStruct((b * h, s_pad, 8), jnp.float32),
         ],
         grid=(b * h, nblk, nblk),
         in_specs=[
@@ -217,7 +224,7 @@ def _flash_fwd_impl(q, k, v, causal, block, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, blk, d_pad), lambda bhi, qi, ki: (bhi, qi, 0)),
-            pl.BlockSpec((1, blk), lambda bhi, qi, ki: (bhi, qi)),
+            pl.BlockSpec((1, blk, 8), lambda bhi, qi, ki: (bhi, qi, 0)),
         ],
         scratch_shapes=[
             pltpu.VMEM((blk, d_pad), jnp.float32),  # acc
@@ -249,14 +256,16 @@ def _flash_bwd(causal, block, interpret, res, dout):
     dop = _prep(dout, b, h, s, d, s_pad, d_pad)
     op = _prep(out, b, h, s, d, s_pad, d_pad)
     # D_i = rowsum(dO * O) — the softmax-derivative correction term.
+    # Stored 8 lanes wide like lse (mosaic tiling rule).
     dd = jnp.sum(dop.astype(jnp.float32) * op.astype(jnp.float32), axis=-1)
+    dd = jnp.broadcast_to(dd[..., None], (*dd.shape, 8))
     # lse pad rows: 0 is safe — their dO rows are zero, so every term
     # they touch (p * 0, ds * 0) vanishes before it reaches real rows.
 
     qkv_spec = pl.BlockSpec((1, blk, d_pad), lambda bhi, i, j: (bhi, i, 0))
     kv_of_j = pl.BlockSpec((1, blk, d_pad), lambda bhi, i, j: (bhi, j, 0))
-    row_of_i = pl.BlockSpec((1, blk), lambda bhi, i, j: (bhi, i))
-    row_of_j = pl.BlockSpec((1, blk), lambda bhi, i, j: (bhi, j))
+    row_of_i = pl.BlockSpec((1, blk, 8), lambda bhi, i, j: (bhi, i, 0))
+    row_of_j = pl.BlockSpec((1, blk, 8), lambda bhi, i, j: (bhi, j, 0))
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, block=blk, causal=causal, scale=scale),

@@ -26,6 +26,11 @@ class Settings:
     MAX_MESSAGE_SIZE: int = 1024 * 1024 * 1024
     """Max gRPC message size (1 GiB) — parity with grpc_server.py:65."""
 
+    GRPC_SERVER_WORKERS: int = 16
+    """gRPC server handler threads. The reference pins 2
+    (grpc_server.py:67); a multislice host fanning out to tens of peers
+    serializes handler work at that width — raise for dense hubs."""
+
     # --- logging ---
     LOG_LEVEL: str = "INFO"
     FILE_LOGGER: bool = True
@@ -53,6 +58,15 @@ class Settings:
     SIM_MAX_BATCH_NODES: int = 128
     """Chunk size for the vmapped batched fit (memory bound: params ×
     chunk nodes resident). SURVEY 'hard parts': 1000-node sim."""
+
+    SIM_PROCESS_ISOLATION: bool = False
+    """When True, the pool's fallback fits run in spawned worker
+    processes (tpfl.simulation.isolated): a crashing learner / native
+    segfault kills one worker, not the whole federation — the
+    reference's Ray-actor isolation property (actor_pool.py:203-357),
+    opt-in because process round-trips cost what the thread pool
+    avoids. Scope: plain JaxLearner fits (no callbacks/aux); other
+    jobs stay on the thread pool."""
 
     # --- heartbeat ---
     HEARTBEAT_PERIOD: float = 2.0

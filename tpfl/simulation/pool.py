@@ -196,7 +196,22 @@ class SuperLearnerPool:
                 else:
                     j.done.set()
 
-        futures = [(j, self._fallback.submit(j.learner.fit)) for j in singles]
+        def run_single(learner):
+            if Settings.SIM_PROCESS_ISOLATION:
+                from tpfl.simulation import isolated
+
+                payload = isolated.extract_job(learner)
+                if payload is not None:
+                    return isolated.isolated_fit(learner, payload)
+                logger.debug(
+                    "simulation",
+                    "fit outside isolation scope; running in-process",
+                )
+            return learner.fit()
+
+        futures = [
+            (j, self._fallback.submit(run_single, j.learner)) for j in singles
+        ]
         for j, fut in futures:
             try:
                 fut.result()
