@@ -572,6 +572,109 @@ def test_transformer_lm_with_ring_attention_seam():
         )
 
 
+def test_transformer_lm_trains_with_ring_attention():
+    """The long-context stack TRAINS sequence-parallel: gradient steps
+    through ring attention on the sp mesh match the single-device
+    blockwise model step for step."""
+    import optax
+
+    from tpfl.models import TransformerLM, create_model
+    from tpfl.parallel import make_ring_attention
+
+    model = create_model(
+        "transformer_lm", (64,), seed=0, vocab=32, dim=32, heads=2,
+        n_layers=1, compute_dtype=jnp.float32,
+    )
+    params0 = model.get_parameters()
+    mesh = create_mesh({"sp": 8})
+    ring_mod = TransformerLM(
+        vocab=32, dim=32, heads=2, n_layers=1,
+        compute_dtype=jnp.float32, attention_fn=make_ring_attention(mesh, causal=True),
+    )
+    base_mod = TransformerLM(
+        vocab=32, dim=32, heads=2, n_layers=1, compute_dtype=jnp.float32
+    )
+
+    rng = np.random.default_rng(1)
+    tokens = jnp.asarray(rng.integers(0, 31, (2, 64)), jnp.int32)
+
+    def make_step(mod):
+        tx = optax.sgd(0.1)
+
+        def loss_of(p):
+            logits = mod.apply({"params": p}, tokens, train=True)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], tokens[:, 1:]
+            ).mean()
+
+        @jax.jit
+        def step(p, o):
+            loss, g = jax.value_and_grad(loss_of)(p)
+            u, o = tx.update(g, o, p)
+            return optax.apply_updates(p, u), o, loss
+
+        return step, tx.init(params0)
+
+    ring_step, ring_opt = make_step(ring_mod)
+    base_step, base_opt = make_step(base_mod)
+    rp, bp = params0, params0
+    ring_losses, base_losses = [], []
+    for _ in range(3):
+        rp, ring_opt, rl = ring_step(rp, ring_opt)
+        bp, base_opt, bl = base_step(bp, base_opt)
+        ring_losses.append(float(rl))
+        base_losses.append(float(bl))
+    np.testing.assert_allclose(ring_losses, base_losses, rtol=1e-4)
+    assert ring_losses[-1] < ring_losses[0]
+    for g, w in zip(
+        jax.tree_util.tree_leaves(rp), jax.tree_util.tree_leaves(bp)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(w), atol=5e-4
+        )
+
+
+def test_composed_dp_sp_mesh_train_step():
+    """Axes compose: one mesh with dp x sp, batch sharded over dp,
+    ring attention over sp, one jitted train step executes and the
+    loss is finite."""
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from tpfl.models import TransformerLM
+    from tpfl.parallel import make_ring_attention
+
+    mesh = create_mesh({"dp": 2, "sp": 4})
+    mod = TransformerLM(
+        vocab=32, dim=32, heads=2, n_layers=1,
+        compute_dtype=jnp.float32,
+        attention_fn=make_ring_attention(mesh, axis_name="sp", causal=True),
+    )
+    rng = np.random.default_rng(2)
+    tokens = jnp.asarray(rng.integers(0, 31, (4, 32)), jnp.int32)
+    params = mod.init(jax.random.PRNGKey(0), tokens[:1], train=False)["params"]
+    tx = optax.sgd(0.1)
+    opt = tx.init(params)
+    tokens = jax.device_put(
+        tokens, NamedSharding(mesh, PartitionSpec("dp", "sp"))
+    )
+
+    @jax.jit
+    def step(p, o, t):
+        def loss_of(pp):
+            logits = mod.apply({"params": pp}, t, train=True)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], t[:, 1:]
+            ).mean()
+
+        loss, g = jax.value_and_grad(loss_of)(p)
+        u, o = tx.update(g, o, p)
+        return optax.apply_updates(p, u), o, loss
+
+    params, opt, loss = step(params, opt, tokens)
+    assert np.isfinite(float(loss))
+
+
 def test_pipeline_parallel_matches_sequential():
     """GPipe-style pipeline over a pp axis: microbatched, stage-sharded
     params, activations ppermuted down the pipe — exactly equal to the

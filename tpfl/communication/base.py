@@ -128,13 +128,17 @@ class ThreadedCommunicationProtocol(CommunicationProtocol):
         cmd: str,
         args: Optional[list[str]] = None,
         round: Optional[int] = None,
+        ttl: Optional[int] = None,
     ) -> Message:
+        """``ttl``: override the flood depth (default Settings.TTL);
+        ttl=1 means direct delivery only, no re-flood (heartbeat
+        digests)."""
         return Message(
             source=self._addr,
             cmd=cmd,
             round=-1 if round is None else round,
             args=[str(a) for a in (args or [])],
-            ttl=Settings.TTL,
+            ttl=Settings.TTL if ttl is None else ttl,
         ).new_hash()
 
     def build_weights(
@@ -268,7 +272,7 @@ class ThreadedCommunicationProtocol(CommunicationProtocol):
         self._neighbors.remove(source, disconnect_msg=False)
 
     def _heartbeat_handler(self, source: str, args: list[str], **kwargs: Any) -> None:
-        self._heartbeater.beat(source, float(args[0]))
+        self._heartbeater.beat(source, args)
 
     def _gossip_send(self, nei: str, msg: Message) -> None:
         self.send(nei, msg)

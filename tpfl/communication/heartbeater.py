@@ -1,9 +1,20 @@
-"""Heartbeater — liveness broadcasting + stale-peer eviction.
+"""Heartbeater — liveness + membership via age-stamped digests.
 
-Parity with reference ``communication/protocols/heartbeater.py:33-113``:
-broadcast a ``beat`` every HEARTBEAT_PERIOD, evict neighbors silent for
-HEARTBEAT_TIMEOUT. Beats gossip with TTL, so non-direct peers are
-discovered passively (reference heartbeater.py:64-78).
+Reference behavior (``communication/protocols/heartbeater.py:33-113``):
+broadcast a ``beat`` every HEARTBEAT_PERIOD, TTL-flood it so non-direct
+peers are discovered passively, evict peers silent for
+HEARTBEAT_TIMEOUT. Flooding every beat costs O(N²) deliveries per
+period network-wide — measured to collapse a 500-node in-process
+federation (tens of thousands of spurious evictions before convergence).
+
+tpfl redesign: beats go to DIRECT neighbors only (ttl=1, no re-flood)
+and carry a digest of every peer this node knows with the AGE (seconds
+since last heard) of each. Receivers merge: ``last_seen = now - age``,
+monotonically (see ``Neighbors.refresh_or_add``). Liveness and full-view
+discovery still propagate transitively — in O(diameter) periods — but
+the per-period cost drops to O(edges) messages of O(N) size instead of
+O(N²) deliveries. Ages are relative, so no cross-node clock sync is
+assumed (transit adds sub-second optimism, far below any sane timeout).
 """
 
 from __future__ import annotations
@@ -35,15 +46,39 @@ class Heartbeater(threading.Thread):
         self._build_msg = build_msg_fn
         self._stop_event = threading.Event()
 
-    def beat(self, source: str, beat_time: float) -> None:
-        """Incoming beat: refresh or learn the peer."""
-        self._neighbors.refresh_or_add(source, beat_time=time.time())
+    def beat(self, source: str, args: list[str]) -> None:
+        """Incoming beat: refresh the sender, merge its digest.
+
+        ``args``: ``[sender_ts, addr_1, age_1, addr_2, age_2, ...]`` —
+        the sender's peer table as (address, seconds-since-heard)."""
+        now = time.time()
+        self._neighbors.refresh_or_add(source, beat_time=now)
+        it = iter(args[1:])
+        for addr, age in zip(it, it):
+            if addr == self._addr or addr == source:
+                continue
+            try:
+                self._neighbors.refresh_or_add(
+                    addr, beat_time=now - float(age)
+                )
+            except ValueError:
+                logger.debug(self._addr, f"Malformed digest entry {addr!r}")
+
+    def _digest(self) -> list[str]:
+        now = time.time()
+        args = [str(now)]
+        for addr, nei in self._neighbors.get_all().items():
+            args.append(addr)
+            args.append(f"{max(0.0, now - nei.last_beat):.3f}")
+        return args
 
     def run(self) -> None:
         while not self._stop_event.is_set():
             try:
+                # ttl=1: direct neighbors only — membership rides the
+                # digest, not a flood.
                 self._broadcast(
-                    self._build_msg(HEARTBEAT_CMD, [str(time.time())])
+                    self._build_msg(HEARTBEAT_CMD, self._digest(), ttl=1)
                 )
             except Exception as e:
                 logger.debug(self._addr, f"Heartbeat broadcast failed: {e}")

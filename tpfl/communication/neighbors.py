@@ -114,14 +114,17 @@ class Neighbors:
 
     def refresh_or_add(self, addr: str, beat_time: Optional[float] = None) -> None:
         """Heartbeat intake (reference heartbeater.py:64-78): refresh a
-        known peer or learn a non-direct one."""
+        known peer or learn a non-direct one. Freshness merges
+        MONOTONICALLY — a relayed digest carrying an older observation
+        of a peer must never regress the freshness a direct beat
+        already established."""
         if addr == self.self_addr:
             return
         t = beat_time if beat_time is not None else time.time()
         with self._lock:
             nei = self._neighbors.get(addr)
             if nei is not None:
-                nei.last_beat = t
+                nei.last_beat = max(nei.last_beat, t)
                 return
         self.add(addr, non_direct=True)
 
