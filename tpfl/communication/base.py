@@ -232,6 +232,7 @@ class ThreadedCommunicationProtocol(CommunicationProtocol):
         model_fn,
         period: Optional[float] = None,
         create_connection: bool = False,
+        exit_on_static: Optional[int] = None,
     ) -> None:
         self._gossiper.gossip_weights(
             early_stopping_fn,
@@ -242,6 +243,7 @@ class ThreadedCommunicationProtocol(CommunicationProtocol):
             send_fn=lambda nei, msg: self.send(
                 nei, msg, create_connection=create_connection
             ),
+            exit_on_static=exit_on_static,
         )
 
     # --- internals shared by all transports ---

@@ -76,6 +76,37 @@ class ModelInitializedCommand(NodeCommand):
         self.state.nei_status[source] = -1
 
 
+class InitModelRequestCommand(NodeCommand):
+    """Pull path for init weights (tpfl addition, no reference
+    analog): a node stuck waiting for the initial model asks its direct
+    neighbors. Push-only diffusion (InitModelCommand gossip) provably
+    strands stragglers at scale — a 500-node StartLearning flood takes
+    tens of seconds to spread, and any hub whose init-gossip quiet
+    window expired first never pushes again. The requester re-asks
+    every few seconds, so convergence no longer depends on start-time
+    skew."""
+
+    name = "init_model_request"
+
+    def execute(self, source: str, round: int, **kwargs: Any) -> None:
+        st = self.state
+        if not st.model_initialized_event.is_set() or st.status != "Learning":
+            return  # nothing to serve
+        try:
+            payload = self.node.learner.get_model().encode_parameters()
+        except Exception as e:
+            logger.debug(st.addr, f"init request from {source} failed: {e}")
+            return
+        self.node.communication.send(
+            source,
+            self.node.communication.build_weights(
+                InitModelCommand.name,
+                st.round if st.round is not None else 0,
+                payload,
+            ),
+        )
+
+
 class VoteTrainSetCommand(NodeCommand):
     """Train-set vote intake (reference vote_train_set_command.py:28):
     args are flattened (candidate, weight) pairs; accept current or next
@@ -155,6 +186,20 @@ class InitModelCommand(NodeCommand):
         st = self.state
         if st.model_initialized_event.is_set():
             logger.debug(st.addr, f"InitModel from {source} ignored (already init)")
+            return
+        if st.status != "Learning":
+            # Reference parity (init_model_command.py:46-97: weights are
+            # taken only while the init lock is held): an IDLE node —
+            # e.g. a late joiner that missed this experiment's
+            # StartLearning — must not adopt stray init weights, or its
+            # init event stays set and the NEXT experiment skips the
+            # init wait and trains from stale weights. A node whose
+            # learning thread hasn't reached the stage yet simply drops
+            # this push; the sender's init gossip re-pushes every
+            # period until we announce.
+            logger.debug(
+                st.addr, f"InitModel from {source} ignored (not learning)"
+            )
             return
         try:
             self.node.learner.set_model(weights)
@@ -267,6 +312,7 @@ ALL_COMMANDS = [
     StartLearningCommand,
     StopLearningCommand,
     ModelInitializedCommand,
+    InitModelRequestCommand,
     VoteTrainSetCommand,
     ModelsAggregatedCommand,
     ModelsReadyCommand,

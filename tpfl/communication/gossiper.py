@@ -52,6 +52,7 @@ class Gossiper(threading.Thread):
         self._processed_set: set[str] = set()
         self._processed_lock = threading.Lock()
         self._stop_event = threading.Event()
+        self._wake = threading.Event()
         seed = (Settings.SEED or 0) + zlib.crc32(self_addr.encode())
         self._rng = random.Random(seed)
 
@@ -82,6 +83,7 @@ class Gossiper(threading.Thread):
         large-N hub cannot starve votes/status indefinitely either."""
         with self._pending_lock:
             (self._priority if priority else self._pending).append(msg)
+        self._wake.set()
 
     def run(self) -> None:
         while not self._stop_event.is_set():
@@ -124,10 +126,19 @@ class Gossiper(threading.Thread):
             if period > 0:
                 self._stop_event.wait(period)
             elif not batch:
-                self._stop_event.wait(0.001)
+                # Event-driven idle: sleep until add_message signals (or
+                # a 200 ms safety tick). Hundreds of idle gossiper
+                # threads polling at 1 ms saturate the GIL by
+                # themselves at 500-node scale.
+                self._wake.clear()
+                with self._pending_lock:
+                    empty = not self._pending and not self._priority
+                if empty and not self._stop_event.is_set():
+                    self._wake.wait(0.2)
 
     def stop(self) -> None:
         self._stop_event.set()
+        self._wake.set()  # break out of an idle wait immediately
 
     # --- synchronous model gossip (reference gossiper.py:163-239) ---
 
@@ -139,18 +150,26 @@ class Gossiper(threading.Thread):
         model_fn: Callable[[str], Optional[Message]],
         period: Optional[float] = None,
         send_fn: Optional[Callable[[str, Message], None]] = None,
+        exit_on_static: Optional[int] = None,
     ) -> None:
         """Push models to sampled peers until convergence or early stop.
 
         Termination conditions (reference order): ``early_stopping_fn``
-        true; no candidates; status unchanged for
-        GOSSIP_EXIT_ON_X_EQUAL_ROUNDS iterations.
+        true; no candidates; status unchanged for ``exit_on_static``
+        iterations (None = Settings.GOSSIP_EXIT_ON_X_EQUAL_ROUNDS;
+        0 = never — callers whose peers have no OTHER supplier, like the
+        init-weights diffusion on a tree topology, must keep pushing
+        until the candidate set itself empties, or late joiners strand).
         """
         if period is None:
             period = Settings.GOSSIP_MODELS_PERIOD
+        if exit_on_static is None:
+            exit_on_static = Settings.GOSSIP_EXIT_ON_X_EQUAL_ROUNDS
         send = send_fn or self._send
+        # maxlen=None (exit_on_static=0) never satisfies the static-exit
+        # check below: len(deque) == None is always False.
         last_statuses: deque[Any] = deque(
-            maxlen=Settings.GOSSIP_EXIT_ON_X_EQUAL_ROUNDS
+            maxlen=exit_on_static if exit_on_static > 0 else None
         )
         while True:
             if early_stopping_fn():

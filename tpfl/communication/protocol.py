@@ -86,6 +86,7 @@ class CommunicationProtocol(ABC):
         model_fn: Callable[[str], Optional[Message]],
         period: Optional[float] = None,
         create_connection: bool = False,
+        exit_on_static: Optional[int] = None,
     ) -> None:
         """Synchronous convergence-driven model gossip (reference
         gossiper.py:163-239); implemented once over the transport

@@ -26,6 +26,12 @@ class Settings:
     MAX_MESSAGE_SIZE: int = 1024 * 1024 * 1024
     """Max gRPC message size (1 GiB) — parity with grpc_server.py:65."""
 
+    INIT_GOSSIP_STATIC_EXIT_S: float = 30.0
+    """Wall-clock quiet window before the init-weights diffusion stops
+    pushing to silent neighbors (StartLearningStage). Iteration-count
+    exits proved too aggressive at 500-node scale, where the
+    StartLearning flood itself takes tens of seconds to spread."""
+
     GRPC_SERVER_WORKERS: int = 16
     """gRPC server handler threads. The reference pins 2
     (grpc_server.py:67); a multislice host fanning out to tens of peers

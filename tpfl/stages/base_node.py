@@ -48,10 +48,27 @@ class StartLearningStage(Stage):
         node.learner.set_epochs(node.epochs)
 
         # Wait for weights: released locally by set_start_learning (the
-        # initiator) or by an incoming InitModelCommand.
+        # initiator), by an incoming InitModelCommand push, or by the
+        # reply to our periodic pull (InitModelRequestCommand) — the
+        # pull is what makes init robust to start-time skew at scale.
+        from tpfl.communication.commands import InitModelRequestCommand
+
+        waited = 0.0
         while not st.model_initialized_event.wait(timeout=0.1):
             if check_early_stop(node):
                 return None
+            waited += 0.1
+            if int(waited * 10) % 50 == 0:  # every ~5 s
+                node.communication.broadcast(
+                    node.communication.build_msg(
+                        InitModelRequestCommand.name, ttl=1
+                    )
+                )
+            if int(waited * 10) % 300 == 0:  # every ~30 s
+                logger.warning(
+                    node.addr,
+                    f"Still waiting for initial model after {waited:.0f}s",
+                )
 
         # Diffuse initial weights to direct neighbors that have not
         # announced a model yet (reference :81-112).
@@ -62,6 +79,9 @@ class StartLearningStage(Stage):
                 if n not in st.nei_status
             ]
 
+        # Encode once: params are fixed during init diffusion, and at a
+        # tree hub re-encoding per push is the dominant cost.
+        init_payload = node.learner.get_model().encode_parameters()
         node.communication.gossip_weights(
             early_stopping_fn=lambda: check_early_stop(node),
             get_candidates_fn=candidates,
@@ -69,7 +89,23 @@ class StartLearningStage(Stage):
             model_fn=lambda nei: node.communication.build_weights(
                 InitModelCommand.name,
                 st.round if st.round is not None else 0,
-                node.learner.get_model().encode_parameters(),
+                init_payload,
+            ),
+            # Time-based static exit instead of the default iteration
+            # count: on sparse topologies (TREE) a leaf has exactly one
+            # supplier, and at 500-node scale the StartLearning flood
+            # takes tens of seconds to reach stragglers — a hub whose
+            # init gossip gives up after a few quiet iterations (2.5 s
+            # under the scale profile) strands every late starter
+            # behind it. A generous wall-clock window still terminates
+            # against a live-but-idle neighbor (one that will never
+            # announce because it isn't in this experiment).
+            exit_on_static=max(
+                1,
+                int(
+                    Settings.INIT_GOSSIP_STATIC_EXIT_S
+                    / max(Settings.GOSSIP_MODELS_PERIOD, 0.01)
+                ),
             ),
         )
         time.sleep(Settings.WAIT_HEARTBEATS_CONVERGENCE)
