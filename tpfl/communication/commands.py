@@ -88,9 +88,22 @@ class InitModelRequestCommand(NodeCommand):
 
     name = "init_model_request"
 
-    def execute(self, source: str, round: int, **kwargs: Any) -> None:
+    def execute(
+        self, source: str, round: int, args: list[str], **kwargs: Any
+    ) -> None:
         st = self.state
-        if not st.model_initialized_event.is_set() or st.status != "Learning":
+        # Serve while learning, or — args carry the requester's
+        # experiment name — after we FINISHED that same experiment
+        # (state cleared, but the final model is exactly what a
+        # straggler needs; its hub finishing first must not strand it).
+        live = st.model_initialized_event.is_set() and st.status == "Learning"
+        finished_same_exp = bool(
+            args
+            and self.node.exp_name is not None
+            and args[0] == self.node.exp_name
+            and st.status != "Learning"
+        )
+        if not (live or finished_same_exp):
             return  # nothing to serve
         try:
             payload = self.node.learner.get_model().encode_parameters()
@@ -306,6 +319,15 @@ class FullModelCommand(NodeCommand):
             return
         st.last_full_model_round = max(st.last_full_model_round, round)
         st.aggregated_model_event.set()
+        if not st.model_initialized_event.is_set():
+            # A round's aggregate is an authoritative model for this
+            # experiment: a straggler still blocked waiting for init
+            # weights (start-flood skew at scale) initializes from it
+            # and re-announces, instead of idling the experiment away.
+            st.model_initialized_event.set()
+            self.node.communication.broadcast(
+                self.node.communication.build_msg(ModelInitializedCommand.name)
+            )
 
 
 ALL_COMMANDS = [

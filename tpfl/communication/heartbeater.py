@@ -52,17 +52,16 @@ class Heartbeater(threading.Thread):
         ``args``: ``[sender_ts, addr_1, age_1, addr_2, age_2, ...]`` —
         the sender's peer table as (address, seconds-since-heard)."""
         now = time.time()
-        self._neighbors.refresh_or_add(source, beat_time=now)
+        entries = [(source, now)]
         it = iter(args[1:])
         for addr, age in zip(it, it):
             if addr == self._addr or addr == source:
                 continue
             try:
-                self._neighbors.refresh_or_add(
-                    addr, beat_time=now - float(age)
-                )
+                entries.append((addr, now - float(age)))
             except ValueError:
                 logger.debug(self._addr, f"Malformed digest entry {addr!r}")
+        self._neighbors.merge_digest(entries)
 
     def _digest(self) -> list[str]:
         now = time.time()

@@ -61,7 +61,12 @@ class StartLearningStage(Stage):
             if int(waited * 10) % 50 == 0:  # every ~5 s
                 node.communication.broadcast(
                     node.communication.build_msg(
-                        InitModelRequestCommand.name, ttl=1
+                        InitModelRequestCommand.name,
+                        # exp name: lets a neighbor that already
+                        # FINISHED this experiment serve us its final
+                        # model instead of leaving us stranded.
+                        [str(node.exp_name)],
+                        ttl=1,
                     )
                 )
             if int(waited * 10) % 300 == 0:  # every ~30 s
