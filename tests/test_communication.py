@@ -337,3 +337,28 @@ def test_heartbeat_priority_relay_order():
         g._send("peer", m)
     assert sent[0] == "beat"  # liveness first
     assert sent.count("vote") == 5  # nothing starved
+
+
+def test_digest_merge_does_not_resurrect_dead_peers():
+    """A relayed digest entry carries its OBSERVED freshness: an
+    unknown peer is added with the carried beat time (not 'now'), and
+    entries already older than max_age are dropped entirely — so an
+    evicted dead node cannot ping-pong back into peer tables with a
+    fresh timestamp."""
+    import time as _time
+
+    from tpfl.communication.neighbors import Neighbors
+
+    n = Neighbors("me")
+    now = _time.time()
+    n.merge_digest(
+        [("stale-peer", now - 500.0), ("recent-peer", now - 3.0)],
+        max_age=120.0,
+    )
+    assert "stale-peer" not in n.get_all()
+    entry = n.get_all()["recent-peer"]
+    assert abs((now - 3.0) - entry.last_beat) < 0.5  # carried, not now
+    # Known peers merge monotonically: an older observation never
+    # regresses freshness.
+    n.merge_digest([("recent-peer", now - 50.0)], max_age=120.0)
+    assert abs((now - 3.0) - n.get_all()["recent-peer"].last_beat) < 0.5

@@ -138,3 +138,22 @@ def test_mismatch_raises():
     )
     with pytest.raises(ValueError, match="module count"):
         from_torch_state_dict(model.get_parameters(), extra.state_dict())
+
+
+def test_export_template_underrun_raises():
+    """A template with fewer modules than the params must raise, not
+    silently drop trailing layers."""
+    import jax.numpy as jnp
+
+    from tpfl.models import create_model
+
+    model = create_model(
+        "mlp", (28, 28), seed=0, hidden_sizes=(256, 128),
+        compute_dtype=jnp.float32,
+    )
+    torch.manual_seed(0)
+    small = torch.nn.Sequential(
+        torch.nn.Linear(784, 256), torch.nn.Linear(256, 128)
+    )
+    with pytest.raises(ValueError, match="consumed"):
+        to_torch_state_dict(model.get_parameters(), small.state_dict())

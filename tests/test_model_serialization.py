@@ -133,3 +133,27 @@ def test_wire_dtype_compression_roundtrip():
             np.testing.assert_allclose(got, np.asarray(want), rtol=1e-2, atol=1e-2)
     finally:
         Settings.WIRE_DTYPE = prev
+
+
+def test_build_copy_from_wire_bytes_restores_dtype():
+    """PartialModel/FullModel intake goes through build_copy(params=
+    bytes); a WIRE_DTYPE downcast must not replace the model's dtypes."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpfl.models import create_model
+    from tpfl.settings import Settings
+
+    model = create_model(
+        "mlp", (8, 8), seed=0, hidden_sizes=(4,), compute_dtype=jnp.float32
+    )
+    model.set_contribution(["a"], 3)
+    snap = Settings.snapshot()
+    try:
+        Settings.WIRE_DTYPE = "bfloat16"
+        wire = model.encode_parameters()
+    finally:
+        Settings.restore(snap)
+    copy = model.build_copy(params=wire)
+    for leaf in jax.tree_util.tree_leaves(copy.get_parameters()):
+        assert leaf.dtype == jnp.float32, leaf.dtype

@@ -92,17 +92,23 @@ class InitModelRequestCommand(NodeCommand):
         self, source: str, round: int, args: list[str], **kwargs: Any
     ) -> None:
         st = self.state
-        # Serve while learning, or — args carry the requester's
-        # experiment name — after we FINISHED that same experiment
-        # (state cleared, but the final model is exactly what a
-        # straggler needs; its hub finishing first must not strand it).
-        live = st.model_initialized_event.is_set() and st.status == "Learning"
-        finished_same_exp = bool(
+        # Serve only for the requester's OWN experiment (args[0]): while
+        # we are learning it, or after we FINISHED it (state cleared,
+        # but the final model is exactly what a straggler needs — its
+        # hub finishing first must not strand it). Without the name
+        # check, a node learning a DIFFERENT experiment would hand the
+        # straggler foreign weights.
+        same_exp = bool(
             args
             and self.node.exp_name is not None
             and args[0] == self.node.exp_name
-            and st.status != "Learning"
         )
+        live = (
+            same_exp
+            and st.model_initialized_event.is_set()
+            and st.status == "Learning"
+        )
+        finished_same_exp = same_exp and st.status != "Learning"
         if not (live or finished_same_exp):
             return  # nothing to serve
         try:

@@ -61,7 +61,9 @@ class Heartbeater(threading.Thread):
                 entries.append((addr, now - float(age)))
             except ValueError:
                 logger.debug(self._addr, f"Malformed digest entry {addr!r}")
-        self._neighbors.merge_digest(entries)
+        self._neighbors.merge_digest(
+            entries, max_age=Settings.HEARTBEAT_TIMEOUT
+        )
 
     def _digest(self) -> list[str]:
         now = time.time()

@@ -47,6 +47,7 @@ class Neighbors:
         non_direct: bool = False,
         conn: Any = None,
         dial: bool = True,
+        beat_time: Optional[float] = None,
     ) -> bool:
         """Add a peer; direct adds may build a transport connection via
         the protocol's connect_fn. Returns success.
@@ -55,15 +56,22 @@ class Neighbors:
         the server-side handshake path (reference
         ``grpc_server.py:135-160`` adds the caller without a reverse
         handshake; the send path dials lazily when first needed).
+
+        ``beat_time``: freshness timestamp for the new entry (default
+        now). Digest intake passes the CARRIED observation time — a
+        peer learned from a relayed digest must not be stamped fresher
+        than anyone actually heard it, or an already-evicted dead peer
+        resurrects and its entry ping-pongs between tables forever.
         """
         if addr == self.self_addr:
             return False
+        stamp = beat_time if beat_time is not None else time.time()
         with self._lock:
             existing = self._neighbors.get(addr)
             if existing is not None:
                 # Upgrade non-direct -> direct if needed.
                 if existing.direct or non_direct:
-                    existing.last_beat = time.time()
+                    existing.last_beat = max(existing.last_beat, stamp)
                     return True
         if not non_direct and dial and self._connect_fn is not None and conn is None:
             try:
@@ -78,14 +86,14 @@ class Neighbors:
             # racing our connect) may have inserted while we dialed.
             existing = self._neighbors.get(addr)
             if existing is not None and (existing.direct or non_direct):
-                existing.last_beat = time.time()
+                existing.last_beat = max(existing.last_beat, stamp)
                 if not non_direct and existing.conn is None and conn is not None:
                     existing.conn = conn  # donate our fresh connection
                 else:
                     leaked = conn  # theirs wins; release ours below
             else:
                 self._neighbors[addr] = Neighbor(
-                    conn=conn, direct=not non_direct, last_beat=time.time()
+                    conn=conn, direct=not non_direct, last_beat=stamp
                 )
         if leaked is not None and self._close_fn is not None:
             try:
@@ -126,14 +134,20 @@ class Neighbors:
             if nei is not None:
                 nei.last_beat = max(nei.last_beat, t)
                 return
-        self.add(addr, non_direct=True)
+        self.add(addr, non_direct=True, beat_time=t)
 
-    def merge_digest(self, entries: list[tuple[str, float]]) -> None:
+    def merge_digest(
+        self, entries: list[tuple[str, float]], max_age: Optional[float] = None
+    ) -> None:
         """Batch heartbeat-digest intake: refresh every known peer under
         ONE lock acquisition (a per-entry refresh_or_add costs a lock
         round-trip each — at 500 nodes x dozens of beats/sec on a
         single-core host that alone saturates the GIL), then add the
-        unknown ones as non-direct peers."""
+        unknown ones as non-direct peers carrying their OBSERVED
+        freshness. ``max_age``: unknown entries already older than this
+        are dropped — re-learning a peer we (or anyone) evicted, with a
+        fresh timestamp, would resurrect dead nodes network-wide."""
+        now = time.time()
         unknown: list[tuple[str, float]] = []
         with self._lock:
             for addr, beat_time in entries:
@@ -142,10 +156,10 @@ class Neighbors:
                 nei = self._neighbors.get(addr)
                 if nei is not None:
                     nei.last_beat = max(nei.last_beat, beat_time)
-                else:
+                elif max_age is None or now - beat_time < max_age:
                     unknown.append((addr, beat_time))
-        for addr, _ in unknown:
-            self.add(addr, non_direct=True)
+        for addr, beat_time in unknown:
+            self.add(addr, non_direct=True, beat_time=beat_time)
 
     def install_conn(self, addr: str, conn: Any) -> Any:
         """Install a back-channel for a direct peer under the table

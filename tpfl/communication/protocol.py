@@ -45,9 +45,14 @@ class CommunicationProtocol(ABC):
 
     @abstractmethod
     def build_msg(
-        self, cmd: str, args: Optional[list[str]] = None, round: Optional[int] = None
+        self,
+        cmd: str,
+        args: Optional[list[str]] = None,
+        round: Optional[int] = None,
+        ttl: Optional[int] = None,
     ) -> Message:
-        """Control message with fresh dedup hash and Settings.TTL."""
+        """Control message with fresh dedup hash; ``ttl`` overrides
+        Settings.TTL (1 = direct delivery only, no re-flood)."""
 
     @abstractmethod
     def build_weights(

@@ -260,4 +260,15 @@ def to_torch_state_dict(
             ):
                 key = f"{prefix}.{tname}" if prefix else tname
                 out[key] = np.asarray(sleaves[fname])
+    # Underrun is as corrupting as overrun: a template with FEWER
+    # modules than the params would silently drop trailing layers.
+    if fi != len(fgroups):
+        raise ValueError(
+            f"template consumed {fi} of {len(fgroups)} flax modules — "
+            f"trailing params would be silently dropped"
+        )
+    if stats_target is not None and si != len(sgroups):
+        raise ValueError(
+            f"template consumed {si} of {len(sgroups)} stat modules"
+        )
     return out

@@ -211,7 +211,11 @@ class TpflModel:
         if params is not None:
             if isinstance(params, bytes):
                 decoded, contribs, n, info = serialization.decode_model_payload(params)
-                m.set_parameters(decoded)
+                # Wire intake (PartialModel/FullModel arrive through
+                # build_copy): restore this model's dtypes exactly like
+                # the direct set_parameters(bytes) path, or a
+                # WIRE_DTYPE downcast would silently stick.
+                m._check_and_set(decoded, restore_dtype=True)
                 m._contributors = contribs
                 m._num_samples = n
                 m.additional_info.update(info)
