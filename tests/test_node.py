@@ -3,6 +3,7 @@
 in one process, asserting the exact stage-history pattern per round,
 cross-node model agreement, and final accuracy > 0.5."""
 
+import jax
 import numpy as np
 import pytest
 
@@ -202,6 +203,54 @@ def test_federated_transformer_lm_converges():
         # 90% predictable, so even a short run gets clearly below it.
         metrics = [nd.learner.evaluate() for nd in nodes]
         assert all(m["test_loss"] < 2.5 for m in metrics), metrics
+    finally:
+        for nd in nodes:
+            nd.stop()
+
+
+def test_federated_batchnorm_model_converges():
+    """E2E federation of a BatchNorm model (tiny ResNet): params are
+    FedAvg'd over the wire while each node's batch_stats stay local
+    (FedBN semantics on the protocol path); training and eval both
+    thread the mutable collections."""
+    from tpfl.learning.dataset import synthetic_classification
+
+    n, rounds = 2, 1
+    ds = synthetic_classification(
+        (8, 8, 3), n_classes=4, n_train=128 * n, n_test=32, seed=0,
+        noise=0.5,
+    )
+    parts = ds.generate_partitions(n, RandomIIDPartitionStrategy, seed=1)
+    nodes = [
+        Node(
+            create_model(
+                "resnet18", (8, 8, 3), seed=7, out_channels=4,
+                stage_sizes=(1,),
+            ),
+            parts[i],
+            learning_rate=0.05,
+            batch_size=32,
+        )
+        for i in range(n)
+    ]
+    for nd in nodes:
+        nd.start()
+    try:
+        nodes[0].connect(nodes[1].addr)
+        wait_convergence(nodes, n - 1, only_direct=False, wait=10)
+        nodes[0].set_start_learning(rounds=rounds, epochs=1)
+        wait_to_finish(nodes, timeout=240)
+        for nd in nodes:
+            assert_stage_history(nd, rounds, None)
+        check_equal_models(nodes)  # params agree (stats are per-node)
+        # Stats actually advanced from init (zero mean) during training.
+        stats = nodes[0].learner.get_model().aux_state
+        assert stats and "batch_stats" in stats
+        leaves = [np.abs(np.asarray(x)).sum()
+                  for x in jax.tree_util.tree_leaves(stats["batch_stats"])]
+        assert sum(leaves) > 0
+        metrics = [nd.learner.evaluate() for nd in nodes]
+        assert all(np.isfinite(m["test_loss"]) for m in metrics), metrics
     finally:
         for nd in nodes:
             nd.stop()
