@@ -208,15 +208,30 @@ class Neighbors:
 
     def evict_stale(self, timeout: float) -> list[str]:
         """Drop peers not heard from within ``timeout`` (reference
-        heartbeater.py:93-103). Returns evicted addresses."""
+        heartbeater.py:93-103). Returns evicted DIRECT addresses (the
+        ones worth logging/acting on).
+
+        Non-direct entries are liveness bookkeeping only (no transport
+        connection): they expire in BULK under the table lock — no
+        per-entry remove() round-trips, no disconnect hooks, no log
+        lines. At 500-node scale, digest entries hovering near the
+        timeout previously churned through add→evict→log cycles whose
+        logging alone starved a single-core host."""
         now = time.time()
         with self._lock:
-            stale = [
-                a for a, n in self._neighbors.items() if now - n.last_beat > timeout
+            stale_direct = [
+                a
+                for a, n in self._neighbors.items()
+                if n.direct and now - n.last_beat > timeout
             ]
-        for a in stale:
+            self._neighbors = {
+                a: n
+                for a, n in self._neighbors.items()
+                if n.direct or now - n.last_beat <= timeout
+            }
+        for a in stale_direct:
             self.remove(a)
-        return stale
+        return stale_direct
 
     def clear(self) -> None:
         with self._lock:

@@ -65,12 +65,21 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
         help="star = single hub (reference-style, ~200-node ceiling); "
         "tree = sqrt(N) meshed hubs (default, 500+ nodes).",
     )
+    p.add_argument(
+        "--election",
+        choices=["vote", "hash"],
+        default="hash",
+        help="vote = reference protocol (O(N^2) vote flood + timeout "
+        "waits); hash = deterministic sortition (default here: zero "
+        "election traffic, recommended at scale).",
+    )
     return p.parse_args(argv)
 
 
 def scale(args: argparse.Namespace) -> dict[str, float]:
     Settings.set_scale_settings()
     Settings.TRAIN_SET_SIZE = args.train_set_size
+    Settings.ELECTION = args.election
     # Digest-based membership costs O(edges) per period (heartbeater
     # docstring), so the cadence no longer needs to scale with N — but
     # full-view convergence takes O(diameter) periods and O(N) digest
@@ -122,6 +131,7 @@ def scale(args: argparse.Namespace) -> dict[str, float]:
         stats = {
             "nodes": n,
             "rounds": args.rounds,
+            "election": args.election,
             "train_set_size": args.train_set_size,
             "setup_s": round(t_ready - t_start, 1),
             "learn_s": round(t_done - t_ready, 1),

@@ -26,6 +26,18 @@ class Settings:
     MAX_MESSAGE_SIZE: int = 1024 * 1024 * 1024
     """Max gRPC message size (1 GiB) — parity with grpc_server.py:65."""
 
+    ELECTION: str = "vote"
+    """Train-set election mode. "vote" (default): the reference's
+    random-weight vote — every node floods a vote and tallies
+    (vote_train_set_stage.py:79-171); O(N²) messages per round plus a
+    VOTE_TIMEOUT wait whenever any vote is missing. "hash":
+    deterministic sortition — rank candidates by
+    H(exp_name, round, addr) and take the top TRAIN_SET_SIZE; zero
+    messages, zero wait, and all nodes agree whenever their membership
+    views agree (digest heartbeats give full view before learning
+    starts). The per-round set still rotates pseudo-randomly with the
+    round number. Recommended for 100+ node federations."""
+
     INIT_GOSSIP_STATIC_EXIT_S: float = 30.0
     """Wall-clock quiet window before the init-weights diffusion stops
     pushing to silent neighbors (StartLearningStage). Iteration-count

@@ -129,6 +129,30 @@ class VoteTrainSetStage(Stage):
             return None
         candidates = list(node.communication.get_neighbors()) + [node.addr]
 
+        if Settings.ELECTION == "hash":
+            # Deterministic sortition (Settings.ELECTION docs): rank by
+            # H(exp|round|addr), top-K — no messages, no vote wait;
+            # agreement follows from membership-view agreement. The
+            # aggregator still tolerates view divergence exactly as it
+            # tolerates missing votes under the vote protocol.
+            import hashlib
+
+            def rank(addr: str) -> str:
+                return hashlib.sha256(
+                    f"{st.exp_name}|{st.round}|{addr}".encode()
+                ).hexdigest()
+
+            ranked = sorted(set(candidates), key=rank)
+            st.train_set = ranked[: Settings.TRAIN_SET_SIZE]
+            logger.info(node.addr, f"Train set (hash): {st.train_set}")
+            if check_early_stop(node):
+                return None
+            return (
+                TrainStage
+                if node.addr in st.train_set
+                else WaitAggregatedModelsStage
+            )
+
         # Cast my vote: sample ≤ TRAIN_SET_SIZE candidates with random
         # weights (reference :79-107), seeded per node for determinism.
         sample = node.rng.sample(
