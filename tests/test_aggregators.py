@@ -258,3 +258,66 @@ def test_fedprox_proximal_term_pulls_toward_anchor():
     free = drift(0.0)
     pinned = drift(10.0)
     assert pinned < free * 0.3, (free, pinned)
+
+
+# --- skipped-fit (num_samples == 0) contract ---
+
+
+def test_fedavg_ignores_zero_weight_models():
+    """A skipped fit's parameters (num_samples == 0) must not move the
+    weighted mean, whatever garbage they hold."""
+    agg = FedAvg("t")
+    out = agg.aggregate(
+        [
+            mk_model(2, 10, ["a"]),
+            mk_model(4, 10, ["b"]),
+            mk_model(9999, 0, ["skipped"]),
+        ]
+    )
+    np.testing.assert_allclose(np.asarray(out.get_parameters()["w"]), 3.0)
+
+
+def test_scaffold_ignores_skipped_models_info():
+    """SCAFFOLD must ignore num_samples == 0 contributions entirely:
+    no crash when they carry no info, and no control-variate pull when
+    they carry a STALE round's info."""
+    agg = Scaffold("t")
+    delta = {
+        "w": jnp.full((2, 2), 1.0, jnp.float32),
+        "b": jnp.full((2,), 1.0, jnp.float32),
+    }
+    trained = mk_model(
+        2,
+        10,
+        ["a"],
+        extra={"scaffold": {"delta_y_i": delta, "delta_c_i": delta}},
+    )
+    # Skipped model WITHOUT info (the post-fix skip_fit contract):
+    out = agg.aggregate([trained, mk_model(7, 0, ["skipped"])])
+    np.testing.assert_allclose(np.asarray(out.get_parameters()["w"]), 2.0)
+
+    # Skipped model WITH stale info (pre-fix payloads on the wire must
+    # still be harmless): deltas of 100 would visibly shift the mean.
+    stale = {
+        "w": jnp.full((2, 2), 100.0, jnp.float32),
+        "b": jnp.full((2,), 100.0, jnp.float32),
+    }
+    agg2 = Scaffold("t")
+    out2 = agg2.aggregate(
+        [
+            trained,
+            mk_model(
+                7,
+                0,
+                ["skipped"],
+                extra={"scaffold": {"delta_y_i": stale, "delta_c_i": stale}},
+            ),
+        ]
+    )
+    np.testing.assert_allclose(np.asarray(out2.get_parameters()["w"]), 2.0)
+
+
+def test_scaffold_all_skipped_raises():
+    agg = Scaffold("t")
+    with pytest.raises(ValueError, match="num_samples == 0"):
+        agg.aggregate([mk_model(1, 0, ["a"]), mk_model(2, 0, ["b"])])

@@ -157,3 +157,126 @@ def test_export_template_underrun_raises():
     )
     with pytest.raises(ValueError, match="consumed"):
         to_torch_state_dict(model.get_parameters(), small.state_dict())
+
+
+# --- Keras interop (reference keras_model.py:121 — the MLP example) ---
+
+
+def _keras():
+    """Import keras lazily and skip when TF is unusable in this env."""
+    try:
+        import keras  # noqa: F401
+
+        return keras
+    except Exception as e:  # pragma: no cover - env-dependent
+        pytest.skip(f"keras unavailable: {e}")
+
+
+def test_keras_mlp_import_forward_parity():
+    """Weights from a real keras.Model mirroring the reference Keras MLP
+    (keras_model.py:121: Dense 784-256-128-10) must reproduce the keras
+    forward through the tpfl flax MLP."""
+    import jax.numpy as jnp
+
+    from tpfl.interop import from_keras_weights
+    from tpfl.models import MLP, create_model
+
+    keras = _keras()
+    km = keras.Sequential(
+        [
+            keras.layers.Input((784,)),
+            keras.layers.Dense(256, activation="relu"),
+            keras.layers.Dense(128, activation="relu"),
+            keras.layers.Dense(10),
+        ]
+    )
+    model = create_model(
+        "mlp", (28, 28), seed=0, hidden_sizes=(256, 128),
+        compute_dtype=jnp.float32,
+    )
+    params = from_keras_weights(model.get_parameters(), km.get_weights())
+
+    x = np.random.default_rng(0).normal(size=(4, 784)).astype(np.float32)
+    want = np.asarray(km(x))
+    got = MLP(hidden_sizes=(256, 128), compute_dtype=jnp.float32).apply(
+        {"params": params}, jnp.asarray(x.reshape(4, 28, 28))
+    )
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-5)
+
+
+def test_keras_weights_round_trip():
+    """to_keras_weights(from_keras_weights(w)) == w, array for array,
+    and a keras model accepts the exported list via set_weights."""
+    import jax.numpy as jnp
+
+    from tpfl.interop import from_keras_weights, to_keras_weights
+    from tpfl.models import create_model
+
+    keras = _keras()
+    km = keras.Sequential(
+        [
+            keras.layers.Input((784,)),
+            keras.layers.Dense(256, activation="relu"),
+            keras.layers.Dense(128, activation="relu"),
+            keras.layers.Dense(10),
+        ]
+    )
+    want = km.get_weights()
+    model = create_model(
+        "mlp", (28, 28), seed=0, hidden_sizes=(256, 128),
+        compute_dtype=jnp.float32,
+    )
+    params = from_keras_weights(model.get_parameters(), want)
+    got = to_keras_weights(params)
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, w)
+    km.set_weights(got)  # keras accepts the exported list
+
+
+def test_keras_batchnorm_stats_roundtrip():
+    """BatchNorm: keras packs [gamma, beta, mean, var] per layer; flax
+    splits scale/bias (params) from mean/var (batch_stats)."""
+    import jax.numpy as jnp
+
+    from tpfl.interop import from_keras_weights, to_keras_weights
+    from tpfl.models import create_model
+
+    model = create_model(
+        "resnet18", (8, 8, 3), seed=0, out_channels=10,
+        stage_sizes=(1,), compute_dtype=jnp.float32,
+    )
+    params = model.get_parameters()
+    aux = model.aux_state
+    flat = to_keras_weights(params, aux)
+    # Perturb every array, import back, re-export: exact round trip.
+    perturbed = [np.asarray(a) + 1.0 for a in flat]
+    new_params, new_aux = from_keras_weights(params, perturbed, aux)
+    again = to_keras_weights(new_params, new_aux)
+    assert len(again) == len(perturbed)
+    for g, w in zip(again, perturbed):
+        np.testing.assert_allclose(g, w, rtol=1e-6)
+
+
+def test_keras_count_mismatch_raises():
+    import jax.numpy as jnp
+
+    from tpfl.interop import from_keras_weights
+    from tpfl.models import create_model
+
+    model = create_model(
+        "mlp", (28, 28), seed=0, hidden_sizes=(16,),
+        compute_dtype=jnp.float32,
+    )
+    params = model.get_parameters()
+    from tpfl.interop import to_keras_weights
+
+    flat = to_keras_weights(params)
+    with pytest.raises(ValueError, match="exhausted"):
+        from_keras_weights(params, flat[:-1])
+    with pytest.raises(ValueError, match="trailing"):
+        from_keras_weights(params, flat + [flat[-1]])
+    bad = list(flat)
+    bad[0] = np.zeros((3, 3), np.float32)
+    with pytest.raises(ValueError, match="does not map"):
+        from_keras_weights(params, bad)

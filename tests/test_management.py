@@ -260,3 +260,21 @@ def test_web_services_client_against_local_server():
     dead = TpflWebServices("http://127.0.0.1:9", "k")
     dead.register_node("n", False)
     dead.send_log("t", "n", "INFO", "m")  # no raise = pass
+
+
+def test_scale_profile_uses_hash_election():
+    """The 100+-node profile must not default to the O(N^2) vote flood:
+    set_scale_settings switches to deterministic sortition (zero vote
+    messages — e2e behavior pinned by
+    test_hash_election_converges_without_vote_traffic), while the
+    GLOBAL default stays 'vote' for reference parity."""
+    from tpfl.settings import Settings
+
+    assert Settings.ELECTION == "vote"  # reference-parity default
+    snap = Settings.snapshot()
+    try:
+        Settings.set_scale_settings()
+        assert Settings.ELECTION == "hash"
+    finally:
+        Settings.restore(snap)
+    assert Settings.ELECTION == "vote"

@@ -241,6 +241,37 @@ def test_isolated_fit_contains_worker_crash():
         isolated.shutdown()
 
 
+def test_isolated_fit_innocent_bystander_survives_pool_break():
+    """A worker crash breaks the SHARED pool for every in-flight job;
+    a concurrently-running innocent job must be retried on the rebuilt
+    pool and succeed — only the crashing job may fail."""
+    import pickle
+    import time
+    from concurrent.futures import ThreadPoolExecutor
+
+    from tpfl.simulation import isolated
+
+    innocent = make_learner("iso-innocent", n=96, seed=7)
+    innocent.set_epochs(1)
+    crasher = make_learner("iso-crasher", n=96, seed=8)
+    crasher.set_epochs(1)
+    crash_job = pickle.loads(isolated.extract_job(crasher))
+    crash_job["_test_crash"] = True
+    try:
+        with ThreadPoolExecutor(2) as tp:
+            f_inn = tp.submit(isolated.isolated_fit, innocent)
+            time.sleep(0.3)  # let the innocent land on a worker first
+            f_crash = tp.submit(
+                isolated.isolated_fit, crasher, pickle.dumps(crash_job)
+            )
+            with pytest.raises(RuntimeError, match="worker died"):
+                f_crash.result(timeout=180)
+            fitted = f_inn.result(timeout=180)
+        assert fitted.get_contributors() == ["iso-innocent"]
+    finally:
+        isolated.shutdown()
+
+
 def test_isolation_scope_gates():
     """Out-of-scope jobs (callbacks / custom optimizer) return None
     from extract_job instead of silently dropping semantics."""

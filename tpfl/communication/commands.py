@@ -108,7 +108,18 @@ class InitModelRequestCommand(NodeCommand):
             and st.model_initialized_event.is_set()
             and st.status == "Learning"
         )
-        finished_same_exp = same_exp and st.status != "Learning"
+        # "Finished" requires positive completion evidence, not merely
+        # status != Learning: exp_name is assigned in
+        # start_learning_thread BEFORE the stage flips status, so a node
+        # hit in that window — or one whose run aborted before init —
+        # would otherwise serve its local randomly-seeded weights and
+        # silently break the requester's common-init assumption.
+        finished_same_exp = (
+            same_exp
+            and st.status != "Learning"
+            and getattr(self.node, "completed_experiment", None)
+            == self.node.exp_name
+        )
         if not (live or finished_same_exp):
             return  # nothing to serve
         try:

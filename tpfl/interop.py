@@ -1,4 +1,4 @@
-"""Torch weight interop — state_dict <-> flax params bridge.
+"""Torch & Keras weight interop — state_dict / get_weights() <-> flax params.
 
 The reference accepts PyTorch/Keras/Flax models through its learner
 factory (``/root/reference/p2pfl/learning/frameworks/learner_factory.py:29-57``);
@@ -27,6 +27,19 @@ map is NOT mechanically convertible — torch flattens C,H,W while flax
 flattens H,W,C, so that one kernel's input dimension needs a manual
 permutation. MLPs on flat inputs and conv stacks up to (and including)
 global pooling convert exactly.
+
+Keras (the reference's second framework:
+``p2pfl/learning/frameworks/tensorflow/keras_model.py:44``,
+``keras_learner.py``) needs NO per-leaf transforms at all — Keras and
+flax share layouts (Dense kernel ``[in, out]``, Conv2D kernel
+``[kh, kw, in, out]``, channels-last flatten order), so
+:func:`from_keras_weights` / :func:`to_keras_weights` only align
+Keras's flat ``model.get_weights()`` list with the flax tree by module
+order: Dense/Conv consume ``[kernel, bias]``, BatchNorm consumes
+``[gamma, beta, moving_mean, moving_var]`` (stats into the
+``batch_stats`` collection), Embedding consumes ``[embeddings]``.
+Round-trip and logit-parity are tested against a real ``keras.Model``
+mirroring the reference MLP (``keras_model.py:121``).
 """
 
 from __future__ import annotations
@@ -45,6 +58,20 @@ def _to_numpy(t: Any) -> np.ndarray:
     if hasattr(t, "detach"):  # torch tensor, no torch import needed
         t = t.detach().cpu().numpy()
     return np.asarray(t)
+
+
+def _apply_updates_ordered(tree: Any, ups: dict, path: tuple = ()) -> Any:
+    """Rebuild ``tree`` with ``ups[path]`` replacing matched leaves,
+    PRESERVING dict insertion order — ``jax.tree_util`` map functions
+    rebuild dicts key-sorted, which destroys the module-definition
+    order this whole module aligns by (a re-exported mixed-type tree
+    would emit modules in the wrong order)."""
+    if isinstance(tree, Mapping):
+        return {
+            k: _apply_updates_ordered(v, ups, path + (str(k),))
+            for k, v in tree.items()
+        }
+    return jax.numpy.asarray(ups.get(path, tree))
 
 
 def _natural_sorted(keys: list) -> list:
@@ -190,11 +217,7 @@ def from_torch_state_dict(
                     tname, tleaves[tname], fname, fleaves[fname]
                 )
 
-        def replace(path, leaf):
-            key = tuple(getattr(p, "key", str(p)) for p in path)
-            return jax.numpy.asarray(updates.get(key, leaf))
-
-        return jax.tree_util.tree_map_with_path(replace, target_tree)
+        return _apply_updates_ordered(target_tree, updates)
 
     new_params = fill(params, fgroups, t_param_groups)
     if stats_target is None:
@@ -272,3 +295,123 @@ def to_torch_state_dict(
             f"template consumed {si} of {len(sgroups)} stat modules"
         )
     return out
+
+
+# --- Keras interop (flat get_weights() list <-> flax tree) ---
+
+
+def _keras_group_spec(fleaves: dict) -> list[str]:
+    """Flax leaf names of one module in Keras's get_weights() order."""
+    if "scale" in fleaves:  # BatchNorm/LayerNorm: gamma, beta
+        names = ["scale"]
+        if "bias" in fleaves:
+            names.append("bias")
+        return names
+    if "kernel" in fleaves:
+        return ["kernel"] + (["bias"] if "bias" in fleaves else [])
+    if "embedding" in fleaves:
+        return ["embedding"]
+    raise ValueError(
+        f"module with leaves {sorted(fleaves)} has no Keras counterpart"
+    )
+
+
+def to_keras_weights(params: Any, aux: Optional[Any] = None) -> list[np.ndarray]:
+    """Export flax params (+ optional ``{"batch_stats": ...}`` aux) as a
+    ``keras.Model.set_weights``-ready flat list. Layouts are shared, so
+    arrays pass through untransposed; only the ordering is produced:
+    module order, with BatchNorm emitting gamma, beta, moving_mean,
+    moving_var together (Keras packs stats with the layer, flax keeps
+    them in a separate collection)."""
+    fgroups = _flax_groups(params)
+    stats = aux["batch_stats"] if aux is not None else None
+    sgroups = _flax_groups(stats) if stats is not None else []
+    # Stats pair with their norm layer BY MODULE PATH (the same path
+    # exists in both the params and batch_stats collections), never
+    # positionally — a LayerNorm also carries 'scale' but has no
+    # batch_stats entry and must not swallow a BatchNorm's stats.
+    stats_by_path = dict(sgroups)
+    consumed: set = set()
+    out: list[np.ndarray] = []
+    for fpath, fleaves in fgroups:
+        for name in _keras_group_spec(fleaves):
+            out.append(np.asarray(fleaves[name]))
+        if "scale" in fleaves and stats is not None and fpath in stats_by_path:
+            sleaves = stats_by_path[fpath]
+            consumed.add(fpath)
+            for name in ("mean", "var"):
+                if name in sleaves:
+                    out.append(np.asarray(sleaves[name]))
+    if stats is not None and len(consumed) != len(sgroups):
+        missing = sorted(set(stats_by_path) - consumed)
+        raise ValueError(
+            f"batch_stats modules with no matching norm layer in params: "
+            f"{missing}"
+        )
+    return out
+
+
+def from_keras_weights(
+    params: Any,
+    weights: list,
+    aux: Optional[Any] = None,
+) -> Any:
+    """Fill a flax params tree from ``keras.Model.get_weights()``.
+
+    ``params`` provides structure/shapes/dtypes. With ``aux``,
+    BatchNorm moving stats are consumed into ``batch_stats`` and
+    ``(params, aux)`` is returned. Raises on count or shape mismatch —
+    silent misalignment would corrupt every layer after it."""
+    fgroups = _flax_groups(params)
+    stats = aux["batch_stats"] if aux is not None else None
+    sgroups = _flax_groups(stats) if stats is not None else []
+    stats_by_path = dict(sgroups)  # paired by module path, not position
+    consumed: set = set()
+    arrays = [_to_numpy(w) for w in weights]
+    wi = 0
+    updates: dict[tuple, np.ndarray] = {}
+    stat_updates: dict[tuple, np.ndarray] = {}
+
+    def take(target, fpath, fname, store):
+        nonlocal wi
+        if wi >= len(arrays):
+            raise ValueError(
+                f"keras weights exhausted at flax leaf {fpath + (fname,)}"
+            )
+        arr = arrays[wi]
+        wi += 1
+        want = np.shape(target)
+        if arr.shape != want:
+            raise ValueError(
+                f"keras weight #{wi - 1} {arr.shape} does not map onto "
+                f"flax '{'/'.join(fpath + (fname,))}' {want}"
+            )
+        store[fpath + (fname,)] = arr.astype(np.asarray(target).dtype)
+
+    for fpath, fleaves in fgroups:
+        for name in _keras_group_spec(fleaves):
+            take(fleaves[name], fpath, name, updates)
+        if "scale" in fleaves and stats is not None and fpath in stats_by_path:
+            sleaves = stats_by_path[fpath]
+            consumed.add(fpath)
+            for name in ("mean", "var"):
+                if name in sleaves:
+                    take(sleaves[name], fpath, name, stat_updates)
+    if wi != len(arrays):
+        raise ValueError(
+            f"consumed {wi} of {len(arrays)} keras weights — trailing "
+            f"keras layers have no flax counterpart"
+        )
+    if stats is not None and len(consumed) != len(sgroups):
+        missing = sorted(set(stats_by_path) - consumed)
+        raise ValueError(
+            f"batch_stats modules with no matching norm layer in params: "
+            f"{missing}"
+        )
+
+    new_params = _apply_updates_ordered(params, updates)
+    if stats is None:
+        return new_params
+    new_aux = dict(aux)
+    new_aux["batch_stats"] = _apply_updates_ordered(stats, stat_updates)
+    return new_params, new_aux

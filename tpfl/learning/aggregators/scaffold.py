@@ -57,6 +57,17 @@ class Scaffold(Aggregator):
     def aggregate(self, models: list[TpflModel]) -> TpflModel:
         if not models:
             raise ValueError("No models to aggregate")
+        # Skipped fits (num_samples == 0 — interrupted/lapped trainers)
+        # did no local steps: they carry no fresh deltas and must not
+        # pull the control variates toward zero (or, worse, replay a
+        # stale round's info). Ignore them entirely.
+        trained = [m for m in models if m.get_num_samples() > 0]
+        if not trained:
+            raise ValueError(
+                "No trained models to aggregate (all contributions "
+                "have num_samples == 0)"
+            )
+        models = trained
         delta_ys, delta_cs = [], []
         for m in models:
             info = m.get_info().get(INFO_KEY)

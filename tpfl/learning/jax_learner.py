@@ -361,9 +361,26 @@ class JaxLearner(Learner):
         rebound to the round aggregate by a concurrent FullModelCommand,
         and the aggregate's metadata must not be clobbered."""
         model = model if model is not None else self.get_model()
-        model.set_contribution([self._addr], 0)
-        self._last_fit_model = model
-        return model
+        # Work on a copy: ``model`` may BE the learner's live round
+        # aggregate (rebound by a concurrent FullModelCommand), whose
+        # metadata — including aggregator-produced info like SCAFFOLD's
+        # global_c — this node still gossips to peers and must not
+        # mutate. The copy shares the param arrays (no weight copy).
+        skipped = model.build_copy(
+            params=model.get_parameters(),
+            contributors=[self._addr],
+            num_samples=0,
+            additional_info=dict(model.additional_info),
+        )
+        # Strip callback info a previous finish_fit may have attached:
+        # a skipped fit must not ship a STALE round's SCAFFOLD/FedProx
+        # deltas to the aggregator (the num_samples==0 contract alone
+        # does not protect an aggregator that reads info before
+        # checking the weight).
+        for cb in self.callbacks:
+            skipped.additional_info.pop(cb.get_name(), None)
+        self._last_fit_model = skipped
+        return skipped
 
     def fit(self) -> TpflModel:
         """Run ``self.epochs`` local epochs; one XLA program per epoch."""

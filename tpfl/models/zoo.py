@@ -36,21 +36,106 @@ class MLP(nn.Module):
         return x.astype(jnp.float32)
 
 
+class TpflConv(nn.Conv):
+    """``nn.Conv`` with a selectable gradient lowering — same forward
+    op, same param layout/init (pass ``name="Conv_i"`` for tree/RNG
+    parity with a plain ``nn.Conv`` stack).
+
+    ``impl="fwd_bwd"``: gradients via
+    :func:`tpfl.parallel.conv_kernel.conv_fwd_style` — both backward
+    convs expressed as forward-style convolutions, which vmap into
+    XLA's fast grouped lowering (the per-node federation path);
+    numerically identical to autodiff. ``impl="pallas"``: backward via
+    the Pallas im2col kernels (kept as the seam for future Mosaic
+    tuning; measured SLOWER than XLA's grouped path on v5e today).
+    Only the zoo-CNN case is supported: stride 1, SAME padding, odd
+    square kernel, no grouping."""
+
+    impl: str = "fwd_bwd"
+
+    @nn.compact
+    def __call__(self, inputs):
+        from tpfl.parallel.conv_kernel import conv_fwd_style, node_conv
+
+        kh, kw = self.kernel_size
+        if (
+            (self.strides not in (1, (1, 1), None))
+            or self.padding != "SAME"
+            or kh != kw
+            or kh % 2 == 0
+            or self.feature_group_count != 1
+            or (self.kernel_dilation not in (1, (1, 1), None))
+            or (self.input_dilation not in (1, (1, 1), None))
+        ):
+            raise NotImplementedError(
+                "TpflConv supports stride 1, SAME padding, odd square "
+                "kernels, no dilation/grouping — use nn.Conv "
+                f"(got strides={self.strides}, padding={self.padding}, "
+                f"kernel={self.kernel_size}, "
+                f"groups={self.feature_group_count})"
+            )
+        cin = inputs.shape[-1]
+        kernel = self.param(
+            "kernel",
+            self.kernel_init,
+            (kh, kw, cin, self.features),
+            self.param_dtype,
+        )
+        bias = (
+            self.param(
+                "bias", self.bias_init, (self.features,), self.param_dtype
+            )
+            if self.use_bias
+            else None
+        )
+        from flax.linen import dtypes as _dtypes
+
+        inputs, kernel, bias = _dtypes.promote_dtype(
+            inputs, kernel, bias, dtype=self.dtype
+        )
+        if self.impl == "pallas":
+            y = node_conv(inputs, kernel)
+        else:
+            y = conv_fwd_style(inputs, kernel)
+        if bias is not None:
+            y = y + bias
+        return y
+
+
 class CNN(nn.Module):
-    """Small conv net for 32×32×3 (CIFAR-10 benchmark tier)."""
+    """Small conv net for 32×32×3 (CIFAR-10 benchmark tier).
+
+    ``conv_impl``: "fwd_bwd" (default) uses :class:`TpflConv` —
+    identical forward and params to ``nn.Conv``, with the backward
+    convs reformulated as forward-style convs (measured ~4% faster
+    100-node federated rounds on v5e, exact grads); "xla" uses plain
+    ``nn.Conv``; "pallas" routes the backward through the Pallas
+    im2col kernels (tested-correct, currently slower — see
+    tpfl.parallel.conv_kernel). The param tree is identical across
+    impls (explicit Conv_i names), so checkpoints and federations mix
+    freely."""
 
     channels: Sequence[int] = (32, 64)
     dense: int = 128
     out_channels: int = 10
     compute_dtype: Any = jnp.bfloat16
+    conv_impl: str = "fwd_bwd"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        impl = self.conv_impl
+        conv_cls = (
+            nn.Conv
+            if impl == "xla"
+            else partial(TpflConv, impl=impl)
+        )
         if x.ndim == 3:  # grayscale [B, H, W] -> [B, H, W, 1]
             x = x[..., None]
         x = x.astype(self.compute_dtype)
-        for ch in self.channels:
-            x = nn.Conv(ch, (3, 3), dtype=self.compute_dtype)(x)
+        for i, ch in enumerate(self.channels):
+            x = conv_cls(
+                ch, (3, 3), dtype=self.compute_dtype, name=f"Conv_{i}"
+            )(x)
             x = nn.relu(x)
             x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = x.reshape((x.shape[0], -1))

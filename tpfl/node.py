@@ -99,6 +99,10 @@ class Node:
         self.rounds: int = 0
         self.epochs: int = 1
         self.exp_name: str = "experiment"
+        # Name of the last experiment that ran to completion HERE —
+        # the evidence InitModelRequestCommand requires before serving
+        # "finished" weights to a straggler (set by RoundFinishedStage).
+        self.completed_experiment: Optional[str] = None
         self.learning_workflow = LearningWorkflow()
         self._learning_thread: Optional[threading.Thread] = None
         self._running = False

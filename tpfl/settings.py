@@ -36,7 +36,21 @@ class Settings:
     messages, zero wait, and all nodes agree whenever their membership
     views agree (digest heartbeats give full view before learning
     starts). The per-round set still rotates pseudo-randomly with the
-    round number. Recommended for 100+ node federations."""
+    round number. Recommended for 100+ node federations.
+
+    Adversarial trade-off: hash ranks are grindable — a participant
+    chooses its own address, so an adversary can precompute an addr
+    that ranks top-K for essentially every round of a known experiment
+    name and guarantee itself permanent train-set membership. The vote
+    protocol (each elector samples with private randomness) does not
+    have this property, which is why "vote" stays the global default
+    and the recommended mode for byzantine settings (pair hash election
+    with a robust aggregator — tpfl.learning.aggregators.robust — if
+    you need both scale and poisoning tolerance). A per-experiment
+    random beacon (e.g. a hash of the init-model bytes) would blunt
+    pre-join grinding but breaks rank agreement for late joiners that
+    adopt a mid-experiment FullModel instead of the init weights, so it
+    is deliberately not mixed in. See docs/protocol.md."""
 
     INIT_GOSSIP_STATIC_EXIT_S: float = 30.0
     """Wall-clock quiet window before the init-weights diffusion stops
@@ -182,6 +196,11 @@ class Settings:
         protocol timeouts sized so control floods and model diffusion
         scale with the node count (the test/standalone profiles assume
         single-digit federations)."""
+        # O(N²) vote flooding is the measured scale killer (500-node
+        # vote runs take ~6x longer than hash-election runs on one
+        # host); deterministic sortition is the profile default. The
+        # GLOBAL default stays "vote" for reference parity.
+        cls.ELECTION = "hash"
         cls.GOSSIP_PERIOD = 0.0
         cls.GOSSIP_MESSAGES_PER_PERIOD = 100_000
         cls.AMOUNT_LAST_MESSAGES_SAVED = 100_000

@@ -7,9 +7,12 @@ batched-vmap pool is threads in one process — faster (no object-store
 round trips), but a hard crash would take all nodes down. With
 ``Settings.SIM_PROCESS_ISOLATION = True`` the pool's FALLBACK path
 (jobs that can't batch) runs each fit in a spawned worker process
-instead: a dead worker surfaces as a per-job error, the executor is
-rebuilt, and every other node keeps running — the reference's isolation
-property restored.
+instead. Workers share one pool, so a crash breaks the WHOLE pool for
+every in-flight job; ``isolated_fit`` rebuilds the pool and retries
+each affected job once (serialized), so a dead worker ends up failing
+only the job that crashed it while concurrent innocents complete on the
+rebuilt pool — the reference's isolation property restored (modulo two
+unrelated crashes hitting the same job's both attempts).
 
 Scope: plain ``JaxLearner`` fits (no aggregator callbacks — SCAFFOLD /
 FedProx state lives in-process; such jobs stay on the thread pool, with
@@ -31,6 +34,10 @@ from tpfl.settings import Settings
 
 _executor = None
 _executor_lock = threading.Lock()
+# Serializes bystander retries after a pool break: a crashing job's
+# retry can then only break a pool while it alone holds the lock, so
+# every other retrying job gets a fresh executor after it.
+_retry_lock = threading.Lock()
 
 
 def _child_init() -> None:
@@ -66,10 +73,18 @@ def _get_executor():
         return _executor
 
 
-def _discard_executor() -> None:
+def _discard_executor(only: Any = None) -> None:
+    """Tear down the current executor. With ``only``, discard it ONLY
+    if it still IS the current one — a late-arriving failure handler
+    for a pool that was already replaced must not shut down the fresh
+    pool other jobs are retrying on (their pending futures would be
+    cancelled, and CancelledError is not BrokenProcessPool)."""
     global _executor
     with _executor_lock:
-        ex, _executor = _executor, None
+        if only is not None and _executor is not only:
+            ex = None
+        else:
+            ex, _executor = _executor, None
     if ex is not None:
         ex.shutdown(wait=False, cancel_futures=True)
 
@@ -168,19 +183,37 @@ def extract_job(learner: Any) -> Optional[bytes]:
 
 def isolated_fit(learner: Any, payload: Optional[bytes] = None) -> Any:
     """Run one fit in a worker process; apply the result to the
-    learner. Raises on worker death (after rebuilding the executor) —
-    the caller treats it as that job failing, nobody else."""
+    learner.
+
+    Workers share one ProcessPoolExecutor, and CPython marks the WHOLE
+    pool broken when any worker dies — so a crash surfaces
+    BrokenProcessPool to every in-flight job, innocents included.
+    Containment therefore takes two steps: rebuild the pool, then retry
+    the job once (retries serialized, so a crashing job's retry breaks
+    only a pool it holds exclusively). The job whose payload actually
+    crashes the worker fails both attempts and raises; a concurrent
+    innocent fails only if a second, unrelated crash also lands on its
+    retry."""
     from concurrent.futures.process import BrokenProcessPool
 
     if payload is None:
         payload = extract_job(learner)
     if payload is None:
         raise ValueError("learner is outside the isolation scope")
+    ex = _get_executor()
     try:
-        result = _get_executor().submit(_child_fit, payload).result()
-    except BrokenProcessPool as e:
-        _discard_executor()  # next job gets a fresh pool
-        raise RuntimeError(f"isolated fit worker died: {e}") from e
+        result = ex.submit(_child_fit, payload).result()
+    except BrokenProcessPool:
+        _discard_executor(only=ex)  # replace the broken pool, not a successor
+        with _retry_lock:
+            ex2 = _get_executor()
+            try:
+                result = ex2.submit(_child_fit, payload).result()
+            except BrokenProcessPool as e:
+                _discard_executor(only=ex2)
+                raise RuntimeError(
+                    f"isolated fit worker died (both attempts): {e}"
+                ) from e
     model = learner.get_model()
     # build_copy(params=bytes) restores the child's contributors and
     # num_samples from the payload itself.

@@ -53,12 +53,12 @@ class StartLearningStage(Stage):
         # pull is what makes init robust to start-time skew at scale.
         from tpfl.communication.commands import InitModelRequestCommand
 
-        waited = 0.0
+        ticks = 0  # integer tick count — a float accumulator drifts
         while not st.model_initialized_event.wait(timeout=0.1):
             if check_early_stop(node):
                 return None
-            waited += 0.1
-            if int(waited * 10) % 50 == 0:  # every ~5 s
+            ticks += 1
+            if ticks % 50 == 0:  # every ~5 s
                 node.communication.broadcast(
                     node.communication.build_msg(
                         InitModelRequestCommand.name,
@@ -69,10 +69,10 @@ class StartLearningStage(Stage):
                         ttl=1,
                     )
                 )
-            if int(waited * 10) % 300 == 0:  # every ~30 s
+            if ticks % 300 == 0:  # every ~30 s
                 logger.warning(
                     node.addr,
-                    f"Still waiting for initial model after {waited:.0f}s",
+                    f"Still waiting for initial model after ~{ticks / 10:.0f}s",
                 )
 
         # Diffuse initial weights to direct neighbors that have not
@@ -501,5 +501,11 @@ class RoundFinishedStage(Stage):
         # Experiment done: final eval, back to idle (reference :66-74).
         TrainStage._evaluate(node)
         logger.experiment_finished(node.addr)
+        # Durable completion evidence: InitModelRequestCommand serves
+        # final weights to stragglers only for experiments that actually
+        # ran to completion here — status checks alone race the window
+        # between start_learning_thread and set_experiment, where an
+        # 'Idle' node would serve its random init weights.
+        node.completed_experiment = st.exp_name
         st.clear()
         return None
