@@ -1,0 +1,38 @@
+# tpfl deployment image (parity: the reference ships /Dockerfile).
+#
+# Two build modes, selected by BASE:
+#   CPU (default, works anywhere — CI, protocol-only hubs, tests):
+#     docker build -t tpfl .
+#   TPU VM (run on a Cloud TPU VM so /dev devices are present; the
+#   libtpu wheel rides the jax[tpu] extra):
+#     docker build -t tpfl --build-arg JAX_EXTRA="jax[tpu]" \
+#       --build-arg PIP_EXTRA_INDEX="-f https://storage.googleapis.com/jax-releases/libtpu_releases.html" .
+#
+# A container is ONE protocol participant (one gRPC port). Multislice
+# deployment = one container per host/slice running
+# `python -m tpfl.examples.multislice` (see docs/deployment.md).
+
+FROM python:3.12-slim
+
+ARG JAX_EXTRA="jax"
+ARG PIP_EXTRA_INDEX=""
+
+WORKDIR /app
+
+ENV PYTHONUNBUFFERED=1 \
+    PIP_DISABLE_PIP_VERSION_CHECK=on \
+    PIP_DEFAULT_TIMEOUT=100
+
+COPY pyproject.toml README.md ./
+COPY tpfl ./tpfl
+
+RUN pip install --no-cache-dir ${PIP_EXTRA_INDEX} "${JAX_EXTRA}" \
+    && pip install --no-cache-dir .
+
+# gRPC default port for the quickstart examples; override at run time.
+EXPOSE 6666
+
+# Passive node by default — join it from a peer (node2/multislice) or
+# exec the CLI: `docker run tpfl tpfl experiment list`. Binds 0.0.0.0
+# so Docker's published port actually reaches the server.
+CMD ["python", "-m", "tpfl.examples.node1", "--port", "6666", "--host", "0.0.0.0"]

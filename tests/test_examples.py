@@ -13,7 +13,7 @@ def test_cli_lists_examples():
     result = CliRunner().invoke(cli_main, ["experiment", "list"])
     assert result.exit_code == 0
     names = result.output.split()
-    assert {"digits", "node1", "node2"} <= set(names)
+    assert {"digits", "node1", "node2", "scale", "multislice"} <= set(names)
 
 
 def test_cli_help_shows_docstring():
@@ -60,3 +60,165 @@ def test_digits_experiment_runs_in_process(capsys):
     finally:
         Settings.restore(snapshot)
         clear_registry()
+
+
+def test_scale_experiment_runs_in_process():
+    """scale.py — the config-4 entrypoint — completes a 12-node TREE /
+    hash-election run in-suite (reference contract: examples are
+    runnable, cli.py:183-189)."""
+    from tpfl.examples.scale import parse_args, scale
+    from tpfl.settings import Settings
+
+    clear_registry()
+    snapshot = Settings.snapshot()
+    try:
+        stats = scale(
+            parse_args(
+                [
+                    "--nodes", "12", "--rounds", "1", "--epochs", "1",
+                    "--samples-per-node", "32", "--train-set-size", "4",
+                    "--heartbeat-period", "0.5",
+                ]
+            )
+        )
+        assert stats["nodes"] == 12
+        assert stats["rounds_per_sec"] > 0
+        assert stats["election"] == "hash"
+    finally:
+        Settings.restore(snapshot)
+        clear_registry()
+
+
+def _spawn_passive(module, args, env_extra=None):
+    """Run an example module as a passive subprocess on the CPU
+    platform (the image registers the TPU plugin at interpreter start;
+    only a config update before backend init selects CPU). Output goes
+    to a temp FILE, unbuffered (-u): a SIGTERM'd child never flushes a
+    block-buffered pipe, and the file lets the caller poll readiness.
+    Returns (proc, log_path)."""
+    import os
+    import subprocess
+    import sys
+    import tempfile
+
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu'); "
+        f"from tpfl.examples.{module} import main; main({args!r})"
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONUNBUFFERED"] = "1"
+    env.update(env_extra or {})
+    log = tempfile.NamedTemporaryFile(
+        mode="w+", suffix=f"-{module}.log", delete=False
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-u", "-c", code],
+        stdout=log,
+        stderr=subprocess.STDOUT,
+        env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    return proc, log.name
+
+
+def _wait_listening(proc, log_path, timeout=120):
+    """Block until the passive child prints its 'listening' banner (the
+    deterministic readiness gate — a fixed sleep loses to slow JAX
+    startup on a single-core host)."""
+    import time
+
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            break
+        with open(log_path) as fh:
+            if "listening" in fh.read():
+                return
+        time.sleep(0.5)
+    with open(log_path) as fh:
+        raise AssertionError(
+            f"passive child not listening within {timeout}s; log:\n"
+            + fh.read()[-2000:]
+        )
+
+
+def _free_ports(n):
+    import socket
+
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def test_node1_node2_pair_over_grpc():
+    """The two-terminal quickstart (reference node1.py/node2.py,
+    node_test.py:80-135): node1 passive in a subprocess, node2 drives
+    in-process, experiment finishes and reports metrics."""
+    from tpfl.examples import node2
+    from tpfl.settings import Settings
+
+    p1_port, p2_port = _free_ports(2)
+    proc, log_path = _spawn_passive(
+        "node1", ["--port", str(p1_port), "--samples", "200"]
+    )
+    snapshot = Settings.snapshot()
+    try:
+        _wait_listening(proc, log_path)
+        node2.main(
+            [
+                "--port", str(p2_port),
+                "--connect-to", f"127.0.0.1:{p1_port}",
+                "--rounds", "1", "--epochs", "1", "--samples", "200",
+            ]
+        )  # returns only when the experiment finished
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except Exception:
+            proc.kill()
+        Settings.restore(snapshot)
+    with open(log_path) as fh:
+        assert "listening" in fh.read()
+
+
+def test_multislice_pair_over_grpc():
+    """multislice.py — the config-5 entrypoint — in its documented
+    two-process-on-localhost form: passive slice subprocess + driving
+    slice in-process, each wrapping a vmapped sub-federation
+    (FederationLearner); only slice aggregates cross gRPC."""
+    from tpfl.examples import multislice
+    from tpfl.settings import Settings
+
+    p1_port, p2_port = _free_ports(2)
+    proc, log_path = _spawn_passive(
+        "multislice",
+        ["--port", str(p1_port), "--local-nodes", "4", "--samples", "400"],
+    )
+    snapshot = Settings.snapshot()
+    try:
+        _wait_listening(proc, log_path)
+        multislice.main(
+            [
+                "--port", str(p2_port),
+                "--connect-to", f"127.0.0.1:{p1_port}",
+                "--local-nodes", "4", "--rounds", "1", "--epochs", "1",
+                "--samples", "400",
+            ]
+        )
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except Exception:
+            proc.kill()
+        Settings.restore(snapshot)
+    with open(log_path) as fh:
+        assert "listening" in fh.read()

@@ -1,0 +1,144 @@
+"""Static multi-device scaling proof (VERDICT r3 #2).
+
+Wall-clock on the 8-virtual-device CPU mesh says nothing (one core), so
+these tests prove the sharding claims from the compiled HLO itself:
+per-device FLOPs fall ~1/d, and the cross-device collectives move
+O(params) bytes regardless of node count or batch size.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpfl.models import CNN, MLP
+from tpfl.parallel import ShardedTrainer, VmapFederation, create_mesh
+from tpfl.parallel.scaling import analyze_compiled, check_scaling, params_bytes
+
+WIDTHS = (1, 2, 4, 8)
+
+
+def _fed_compiled(d, n_nodes, n_batches=2, bs=4):
+    mesh = create_mesh({"nodes": d}, devices=jax.devices()[:d])
+    fed = VmapFederation(
+        MLP(hidden_sizes=(16,), compute_dtype=jnp.float32),
+        n_nodes=n_nodes,
+        mesh=mesh,
+    )
+    params = fed.init_params((8, 8))
+    rng = np.random.default_rng(0)
+    xs = jnp.asarray(
+        rng.normal(size=(n_nodes, n_batches, bs, 8, 8)), jnp.float32
+    )
+    ys = jnp.asarray(rng.integers(0, 10, (n_nodes, n_batches, bs)), jnp.int32)
+    sx, sy = fed.shard_data(xs, ys)
+    w = jnp.ones((n_nodes,), jnp.float32)
+    fn = fed._build_round()
+    return fn.lower(params, sx, sy, w, 1).compile(), params
+
+
+def test_federation_round_scales_statically():
+    """VmapFederation.round at widths 1..8: compute 1/d-partitioned,
+    reduction O(params) and width-independent."""
+    records = []
+    pbytes = None
+    for d in WIDTHS:
+        compiled, params = _fed_compiled(d, n_nodes=8)
+        if pbytes is None:
+            # ONE node's params — the aggregate the all-reduce moves.
+            pbytes = params_bytes(params) // 8
+        rec = analyze_compiled(compiled)
+        rec["width"] = d
+        records.append(rec)
+        if d > 1:
+            assert rec["collectives"].get("all-reduce", 0) > 0, (
+                d,
+                rec,
+            )  # the exact FedAvg reduction rides an all-reduce
+    failures = check_scaling(records, pbytes)
+    assert not failures, "\n".join(failures)
+
+
+def test_federation_collective_bytes_independent_of_node_count():
+    """Doubling the FL node count must not change the bytes the
+    reduction moves across devices (O(params), not O(params x N))."""
+    byts = []
+    for n in (8, 16):
+        compiled, _ = _fed_compiled(2, n_nodes=n)
+        byts.append(analyze_compiled(compiled)["collective_bytes"])
+    assert byts[1] <= 1.25 * byts[0], byts
+
+
+def test_fsdp_train_step_scales_statically():
+    """ShardedTrainer (FSDP): per-device flops fall ~1/d; collective
+    traffic is O(params) (all-gather of sharded leaves + grad
+    reduce-scatter), independent of the global batch size."""
+    records = []
+    pbytes = None
+    per_dev_batch = 4
+    for d in WIDTHS:
+        mesh = create_mesh({"dp": d}, devices=jax.devices()[:d])
+        tr = ShardedTrainer(
+            CNN(
+                channels=(8,),
+                dense=32,
+                compute_dtype=jnp.float32,
+                conv_impl="xla",
+            ),
+            mesh,
+            fsdp=True,
+        )
+        p, opt = tr.init((8, 8, 3))
+        if pbytes is None:
+            pbytes = params_bytes(p)
+        rng = np.random.default_rng(0)
+        # Scale the global batch with d: per-device work constant, so
+        # per-device flops must be ~width-independent here.
+        x = jnp.asarray(
+            rng.normal(size=(per_dev_batch * d, 8, 8, 3)), jnp.float32
+        )
+        y = jnp.asarray(rng.integers(0, 10, (per_dev_batch * d,)), jnp.int32)
+        sx, sy = tr.shard_batch(np.asarray(x), np.asarray(y))
+        fn = tr._build_step(p)
+        compiled = fn.lower(p, opt, sx, sy).compile()
+        rec = analyze_compiled(compiled)
+        rec["width"] = 1  # per-device work is constant by construction
+        rec["raw_width"] = d
+        records.append(rec)
+    # per-device flops constant (weak-scaling formulation)
+    f1 = records[0]["flops"]
+    for r in records:
+        assert 0.7 * f1 <= r["flops"] <= 1.4 * f1, (r["raw_width"], r["flops"], f1)
+    # collectives O(params) — never O(params x width) or O(batch)
+    for r in records[1:]:
+        assert r["collective_bytes"] <= 6 * pbytes, (r, pbytes)
+
+
+def test_fsdp_collective_bytes_independent_of_batch():
+    """FSDP traffic is parameter traffic: doubling the batch must not
+    change the bytes the collectives move."""
+    d = 4
+    byts = []
+    for per_dev_batch in (4, 8):
+        mesh = create_mesh({"dp": d}, devices=jax.devices()[:d])
+        tr = ShardedTrainer(
+            CNN(
+                channels=(8,),
+                dense=32,
+                compute_dtype=jnp.float32,
+                conv_impl="xla",
+            ),
+            mesh,
+            fsdp=True,
+        )
+        p, opt = tr.init((8, 8, 3))
+        rng = np.random.default_rng(0)
+        x = np.asarray(
+            rng.normal(size=(per_dev_batch * d, 8, 8, 3)), np.float32
+        )
+        y = np.asarray(rng.integers(0, 10, (per_dev_batch * d,)), np.int32)
+        sx, sy = tr.shard_batch(x, y)
+        fn = tr._build_step(p)
+        compiled = fn.lower(p, opt, sx, sy).compile()
+        byts.append(analyze_compiled(compiled)["collective_bytes"])
+    assert byts[1] <= 1.25 * byts[0], byts

@@ -29,6 +29,11 @@ from tpfl.utils import wait_to_finish
 def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
     p = argparse.ArgumentParser(description="tpfl multi-slice quickstart.")
     p.add_argument("--port", type=int, required=True)
+    p.add_argument(
+        "--host", type=str, default="127.0.0.1",
+        help="Bind address (0.0.0.0 inside containers so "
+        "published ports are reachable).",
+    )
     p.add_argument("--connect-to", type=str, default=None, help="host:port of a running slice (driving role)")
     p.add_argument("--local-nodes", type=int, default=8)
     p.add_argument("--local-rounds", type=int, default=1)
@@ -45,7 +50,7 @@ def main(argv: list[str] | None = None) -> None:
     node = Node(
         create_model("mlp", (28, 28), seed=args.seed),
         rendered_digits(n_train=args.samples, n_test=400, seed=args.seed + args.port),
-        protocol=GrpcCommunicationProtocol(f"127.0.0.1:{args.port}"),
+        protocol=GrpcCommunicationProtocol(f"{args.host}:{args.port}"),
         learner=FederationLearner(
             n_local_nodes=args.local_nodes,
             local_rounds=args.local_rounds,

@@ -23,6 +23,11 @@ from tpfl.settings import Settings
 def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
     p = argparse.ArgumentParser(description="tpfl gRPC quickstart (passive node).")
     p.add_argument("--port", type=int, required=True)
+    p.add_argument(
+        "--host", type=str, default="127.0.0.1",
+        help="Bind address (0.0.0.0 inside containers so "
+        "published ports are reachable).",
+    )
     p.add_argument("--samples", type=int, default=800)
     p.add_argument("--seed", type=int, default=666)
     return p.parse_args(argv)
@@ -34,7 +39,7 @@ def main(argv: list[str] | None = None) -> None:
     node = Node(
         create_model("mlp", (28, 28), seed=args.seed),
         rendered_digits(n_train=args.samples, n_test=200, seed=args.seed),
-        protocol=GrpcCommunicationProtocol(f"127.0.0.1:{args.port}"),
+        protocol=GrpcCommunicationProtocol(f"{args.host}:{args.port}"),
     )
     node.start()
     print(f"Node listening on {node.addr}; waiting for peers (Ctrl-C to stop)")

@@ -21,6 +21,11 @@ from tpfl.utils import wait_to_finish
 def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
     p = argparse.ArgumentParser(description="tpfl gRPC quickstart (driving node).")
     p.add_argument("--port", type=int, required=True)
+    p.add_argument(
+        "--host", type=str, default="127.0.0.1",
+        help="Bind address (0.0.0.0 inside containers so "
+        "published ports are reachable).",
+    )
     p.add_argument("--connect-to", type=str, required=True, help="host:port of node1")
     p.add_argument("--rounds", type=int, default=2)
     p.add_argument("--epochs", type=int, default=1)
@@ -35,7 +40,7 @@ def main(argv: list[str] | None = None) -> None:
     node = Node(
         create_model("mlp", (28, 28), seed=args.seed),
         rendered_digits(n_train=args.samples, n_test=200, seed=args.seed),
-        protocol=GrpcCommunicationProtocol(f"127.0.0.1:{args.port}"),
+        protocol=GrpcCommunicationProtocol(f"{args.host}:{args.port}"),
     )
     node.start()
     if not node.connect(args.connect_to):

@@ -66,6 +66,15 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
         "tree = sqrt(N) meshed hubs (default, 500+ nodes).",
     )
     p.add_argument(
+        "--heartbeat-period",
+        type=float,
+        default=10.0,
+        help="Digest heartbeat cadence (s). Full-view discovery takes "
+        "O(topology diameter) periods before learning starts; lower it "
+        "for small/quick runs, keep 10s at hundreds of nodes (beat "
+        "relay load scales with N).",
+    )
+    p.add_argument(
         "--election",
         choices=["vote", "hash"],
         default="hash",
@@ -87,8 +96,8 @@ def scale(args: argparse.Namespace) -> dict[str, float]:
     # and a timeout that tolerates a single-core host's GIL being
     # monopolized by a vote flood or a batched-fit dispatch for tens of
     # seconds.
-    Settings.HEARTBEAT_PERIOD = 10.0
-    Settings.HEARTBEAT_TIMEOUT = 120.0
+    Settings.HEARTBEAT_PERIOD = args.heartbeat_period
+    Settings.HEARTBEAT_TIMEOUT = max(120.0, 12 * args.heartbeat_period)
 
     n = args.nodes
     ds = rendered_digits(

@@ -529,3 +529,23 @@ class JaxLearner(Learner):
             for k, v in metrics.items():
                 logger.log_metric(self._addr, k, v)
         return metrics
+
+
+def clear_compiled_caches() -> None:
+    """Drop every process-lifetime compiled-program cache.
+
+    ``_SHARED_PROGRAMS`` / ``_TX_CACHE`` (this module) and the batched
+    fit programs (``tpfl.simulation.batched_fit``) are unbounded
+    module-level dicts keyed by module/config — a long-lived host
+    cycling many architectures accretes compiled programs forever.
+    Called from ``SuperLearnerPool.reset()``; safe any time no fit is
+    in flight (a fresh experiment simply recompiles, numerically
+    identical — tested)."""
+    _SHARED_PROGRAMS.clear()
+    _TX_CACHE.clear()
+    try:
+        from tpfl.simulation import batched_fit
+
+        batched_fit._programs.clear()
+    except Exception:  # simulation may not be importable in slim envs
+        pass

@@ -131,6 +131,22 @@ class ShardedTrainer:
 
     # --- step ---
 
+    def _gather_for_compute(self, p: Any) -> Any:
+        """ZeRO-3 semantics for FSDP: gather the sharded weights for
+        compute (all-gather, O(params)) and keep the activations
+        batch-sharded. Without this, GSPMD computes WITH sharded
+        weights — tensor-parallel style — and re-shards ACTIVATIONS
+        between layers, moving O(batch) bytes per step (caught by
+        tests/test_scaling_model.py). The constraint's transpose
+        reduce-scatters the grads back to the param sharding. No-op
+        when fsdp is off (params already replicated)."""
+        if not self.fsdp:
+            return p
+        replicated = NamedSharding(self.mesh, PartitionSpec())
+        return jax.lax.with_sharding_constraint(
+            p, jax.tree_util.tree_map(lambda _: replicated, p)
+        )
+
     def _build_step(self, params: Any) -> Callable:
         module = self.module
         loss_fn = self._loss_fn
@@ -138,8 +154,11 @@ class ShardedTrainer:
         param_sh = self._param_sharding(params)
         batch_sh = NamedSharding(self.mesh, PartitionSpec(self.axis))
 
+        gather = self._gather_for_compute
+
         def step(params, opt_state, x, y):
             def loss_of(p):
+                p = gather(p)
                 logits = module.apply({"params": p}, x, train=False)
                 return loss_fn(logits, y).mean()
 
@@ -179,8 +198,11 @@ class ShardedTrainer:
         param_sh = self._param_sharding(params)
         batch_sh = NamedSharding(self.mesh, PartitionSpec(self.axis))
 
+        gather = self._gather_for_compute
+
         def step(params, aux, opt_state, x, y):
             def loss_of(p):
+                p = gather(p)  # ZeRO-3 gather — see _gather_for_compute
                 logits, new_aux = module.apply(
                     {"params": p, **aux}, x, train=True, mutable=list(aux)
                 )
