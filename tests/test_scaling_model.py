@@ -142,3 +142,34 @@ def test_fsdp_collective_bytes_independent_of_batch():
         compiled = fn.lower(p, opt, sx, sy).compile()
         byts.append(analyze_compiled(compiled)["collective_bytes"])
     assert byts[1] <= 1.25 * byts[0], byts
+
+
+def test_fsdp_aux_step_collective_bytes_independent_of_batch():
+    """The BatchNorm-threading step (train_step_with_aux) must carry the
+    same ZeRO-3 property as the plain step: parameter traffic only —
+    the gather-for-compute constraint covers BOTH step builders."""
+    from tpfl.models import ResNet18
+
+    d = 4
+    byts = []
+    for per_dev_batch in (4, 8):
+        mesh = create_mesh({"dp": d}, devices=jax.devices()[:d])
+        tr = ShardedTrainer(
+            ResNet18(
+                out_channels=10, stage_sizes=(1,),
+                compute_dtype=jnp.float32,
+            ),
+            mesh,
+            fsdp=True,
+        )
+        p, aux, opt = tr.init_with_aux((8, 8, 3))
+        rng = np.random.default_rng(0)
+        x = np.asarray(
+            rng.normal(size=(per_dev_batch * d, 8, 8, 3)), np.float32
+        )
+        y = np.asarray(rng.integers(0, 10, (per_dev_batch * d,)), np.int32)
+        sx, sy = tr.shard_batch(x, y)
+        fn = tr._build_step_aux(p)
+        compiled = fn.lower(p, aux, opt, sx, sy).compile()
+        byts.append(analyze_compiled(compiled)["collective_bytes"])
+    assert byts[1] <= 1.25 * byts[0], byts

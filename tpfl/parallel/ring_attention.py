@@ -79,7 +79,7 @@ def blockwise_attention(
         k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
     out = _blockwise(q, k, v, causal, block, s)
-    return out[:, :s].astype(out.dtype)
+    return out[:, :s]
 
 
 def _bw_mask(q_idx, k_idx, s_len: int, causal: bool):
