@@ -362,3 +362,69 @@ def test_digest_merge_does_not_resurrect_dead_peers():
     # regresses freshness.
     n.merge_digest([("recent-peer", now - 50.0)], max_age=120.0)
     assert abs((now - 3.0) - n.get_all()["recent-peer"].last_beat) < 0.5
+
+
+def test_full_model_relay_on_first_adoption():
+    """FullModelCommand relays the received payload ONCE to lagging
+    direct neighbors (epidemic diffusion — O(diameter) instead of
+    stage-timing-bound); repeats and up-to-date neighbors are skipped."""
+    import threading
+    from types import SimpleNamespace
+
+    from tpfl.communication.commands import FullModelCommand
+
+    sent = []
+
+    class FakeComm:
+        def get_neighbors(self, only_direct=False):
+            return ["nb-lag", "nb-done", "nb-src"]
+
+        def build_weights(self, cmd, round, weights, contributors=None,
+                          num_samples=0):
+            return {"cmd": cmd, "round": round, "weights": weights,
+                    "contributors": contributors, "num_samples": num_samples}
+
+        def send(self, dest, payload):
+            sent.append((dest, payload))
+
+    class FakeLearner:
+        def set_model(self, weights):
+            self.last = weights
+
+    state = SimpleNamespace(
+        round=3,
+        last_full_model_round=-1,
+        aggregated_model_event=threading.Event(),
+        model_initialized_event=threading.Event(),
+        # nb-lag is behind; nb-done already reported round 3.
+        nei_status={"nb-done": 3},
+        addr="me",
+    )
+    state.model_initialized_event.set()
+    node = SimpleNamespace(
+        state=state, learner=FakeLearner(), communication=FakeComm()
+    )
+    state.relay_lock = threading.Lock()
+    state.last_relayed_round = -1
+    cmd = FullModelCommand(node)
+
+    def wait_sends(n, timeout=10.0):
+        import time
+
+        deadline = time.time() + timeout
+        while len(sent) < n and time.time() < deadline:
+            time.sleep(0.02)
+
+    cmd.execute("nb-src", 3, b"payload", ["a"], 10)
+    wait_sends(1)  # relay runs on a daemon thread
+    # Relayed to the lagging neighbor only — not the sender, not the
+    # up-to-date one.
+    assert [d for d, _ in sent] == ["nb-lag"]
+    assert sent[0][1]["cmd"] == "full_model"
+    assert sent[0][1]["weights"] == b"payload"  # forwarded verbatim
+    assert state.last_full_model_round == 3
+
+    # Same round again: adopted but NOT re-relayed (at most once).
+    cmd.execute("nb-other", 3, b"payload", ["a"], 10)
+    wait_sends(2, timeout=1.0)
+    assert len(sent) == 1

@@ -98,6 +98,12 @@ def scale(args: argparse.Namespace) -> dict[str, float]:
     # seconds.
     Settings.HEARTBEAT_PERIOD = args.heartbeat_period
     Settings.HEARTBEAT_TIMEOUT = max(120.0, 12 * args.heartbeat_period)
+    # Partial-model exchange among the elected trainers serializes on
+    # the GIL with every other node's threads: measured ~6 min to the
+    # first aggregate at 1000 single-core nodes. A flat 120 s wait
+    # makes nearly every node time out before an aggregate even
+    # exists; scale the budget with the federation size.
+    Settings.AGGREGATION_TIMEOUT = max(120.0, 0.6 * args.nodes)
 
     n = args.nodes
     ds = rendered_digits(
@@ -136,6 +142,24 @@ def scale(args: argparse.Namespace) -> dict[str, float]:
         wait_to_finish(nodes, timeout=3600)
         t_done = time.time()
 
+        # Model agreement: "all nodes finished" alone can hide nodes
+        # that timed out of the aggregation wait and ended the round on
+        # their round-start weights. Report how many hold the majority
+        # final model so the RESULT line is honest about convergence.
+        import hashlib
+        from collections import Counter
+
+        import numpy as _np
+
+        def model_digest(nd) -> str:
+            h = hashlib.sha256()
+            for leaf in nd.learner.get_model().get_parameters_list():
+                h.update(_np.asarray(leaf, _np.float32).tobytes())
+            return h.hexdigest()[:12]
+
+        tally = Counter(model_digest(nd) for nd in nodes)
+        agreement = tally.most_common(1)[0][1] / n
+
         rounds_per_sec = args.rounds / (t_done - t_ready)
         stats = {
             "nodes": n,
@@ -145,6 +169,7 @@ def scale(args: argparse.Namespace) -> dict[str, float]:
             "setup_s": round(t_ready - t_start, 1),
             "learn_s": round(t_done - t_ready, 1),
             "rounds_per_sec": round(rounds_per_sec, 4),
+            "model_agreement": round(agreement, 3),
         }
         print("RESULT:", stats)
         return stats

@@ -39,6 +39,13 @@ class NodeState:
         """Highest round for which a FullModel was received/produced —
         compared against the current round by WaitAggregatedModelsStage
         (event-only signalling can lose an early-arriving FullModel)."""
+        self.relay_lock = threading.Lock()
+        self.last_relayed_round: int = -1
+        """Epidemic-relay bookkeeping (FullModelCommand): highest round
+        whose aggregate this node has re-sent to lagging neighbors.
+        Check-and-mark happens under ``relay_lock`` — concurrent
+        deliveries of the same round from two peers (gRPC handler pool)
+        must not both fan the payload out."""
 
         # Gossip bookkeeping
         self.models_aggregated: dict[str, list[str]] = {}
@@ -117,6 +124,8 @@ class NodeState:
             self.models_aggregated = {}
         self.train_set = []
         self.last_full_model_round = -1
+        with self.relay_lock:
+            self.last_relayed_round = -1
         self.votes_ready_event.clear()
         self.aggregated_model_event.clear()
 

@@ -424,6 +424,16 @@ class WaitAggregatedModelsStage(Stage):
             return None
         if status == "timeout":
             logger.warning(node.addr, "Aggregation wait timed out")
+            # Do NOT advertise ModelsReady: we do not hold the round
+            # result, and the announcement would mark us up to date in
+            # every peer's nei_status — exactly the filter the
+            # FullModel pushers AND the epidemic relay use to pick
+            # targets. Staying silent keeps the aggregate flowing
+            # toward us for as long as we remain in this round.
+            # (The reference broadcasts regardless,
+            # wait_agg_models_stage.py:58-63 — at scale that poisons
+            # diffusion for every timed-out node.)
+            return GossipModelStage
         node.communication.broadcast(
             node.communication.build_msg(
                 ModelsReadyCommand.name, [], round=st.round
@@ -441,8 +451,25 @@ class GossipModelStage(Stage):
     def execute(node: "Node") -> Optional[Type[Stage]]:
         st = node.state
 
+        def holds_aggregate() -> bool:
+            # Only push a round result we actually HOLD: trainers set
+            # the watermark when they aggregate, receivers when a
+            # FullModelCommand lands. A node that TIMED OUT of the
+            # aggregation wait reaches this stage with only its
+            # round-start weights — pushing those as an authoritative
+            # FullModel would overwrite real aggregates on peers (the
+            # reference does exactly that, gossip_model_stage.py:55-66;
+            # observed corrupting 1000-node single-core runs where most
+            # nodes time out before the aggregate exists). Such a node
+            # stays quiet; the epidemic relay still delivers the real
+            # aggregate to it if one appears.
+            return (
+                st.round is not None
+                and st.last_full_model_round >= st.round
+            )
+
         def candidates() -> list[str]:
-            if st.round is None:
+            if st.round is None or not holds_aggregate():
                 return []
             return [
                 n
