@@ -406,6 +406,7 @@ def test_full_model_relay_on_first_adoption():
     )
     state.relay_lock = threading.Lock()
     state.last_relayed_round = -1
+    state.model_version = 0
     cmd = FullModelCommand(node)
 
     def wait_sends(n, timeout=10.0):

@@ -213,7 +213,7 @@ def test_hash_election_converges_without_vote_traffic():
     same train set on every node with zero vote messages; the
     federation converges and the per-round set rotates with the round
     number."""
-    import hashlib
+    from tpfl.stages.base_node import election_rank
 
     snap = Settings.snapshot()
     Settings.ELECTION = "hash"
@@ -234,12 +234,14 @@ def test_hash_election_converges_without_vote_traffic():
         # state's train_set itself is cleared at experiment end).
         addrs = sorted(nd.addr for nd in nodes)
 
+        # All nodes share the initiator's beacon (rode the
+        # StartLearning broadcast).
+        beacon = nodes[0].beacon
+        assert beacon and all(nd.beacon == beacon for nd in nodes)
+
         def rank(r):
             return sorted(
-                addrs,
-                key=lambda a: hashlib.sha256(
-                    f"{exp}|{r}|{a}".encode()
-                ).hexdigest(),
+                addrs, key=lambda a: election_rank(exp, beacon, r, a)
             )[: Settings.TRAIN_SET_SIZE]
 
         from tpfl.management.logger import logger as _logger
@@ -259,6 +261,51 @@ def test_hash_election_converges_without_vote_traffic():
         for nd in nodes:
             nd.stop()
         Settings.restore(snap)
+
+
+def test_hash_election_beacon_blunts_address_grinding():
+    """A precomputed-address adversary cannot dominate the beacon-mixed
+    hash election: grind an address that ranks FIRST for rounds 0..9 of
+    a known exp_name under the beacon-less rank (the pre-r5 scheme —
+    such an address is cheap to find), then check its election
+    frequency across experiments with random beacons is consistent
+    with the uniform 1/N draw, not the ~100% the ground address gets
+    when the beacon is absent."""
+    import hashlib
+
+    from tpfl.stages.base_node import election_rank
+
+    honest = [f"node-{i}" for i in range(15)]
+    rounds = range(3)
+
+    def wins(addr, beacon, r):
+        pool = honest + [addr]
+        return min(pool, key=lambda a: election_rank("exp", beacon, r, a)) == addr
+
+    # Grind: when the beacon is a KNOWN constant (pre-beacon scheme ≅
+    # beacon=""), an adversary scans addresses offline until one
+    # out-ranks every honest node in every round — ~16^3 candidates
+    # for 3 rounds vs 15 honest, trivially affordable.
+    floor = {
+        r: min(election_rank("exp", "", r, h) for h in honest) for r in rounds
+    }
+    adv = next(
+        a
+        for a in (f"adv-{i}" for i in range(300000))
+        if all(election_rank("exp", "", r, a) < floor[r] for r in rounds)
+    )
+    assert all(wins(adv, "", r) for r in rounds)  # the grind worked
+
+    # With per-experiment beacons the same address is just another
+    # uniform draw: expected win rate 1/16 per (experiment, round).
+    trials = [(b, r) for b in range(200) for r in rounds]  # 600 draws
+    w = sum(
+        wins(adv, hashlib.sha256(f"beacon-{b}".encode()).hexdigest(), r)
+        for b, r in trials
+    )
+    exp_wins = len(trials) / 16
+    # Binomial(600, 1/16): mean 37.5, sd ~5.9 — accept within 5 sd.
+    assert abs(w - exp_wins) < 5 * (exp_wins * (1 - 1 / 16)) ** 0.5, w
 
 
 def test_federated_batchnorm_model_converges():

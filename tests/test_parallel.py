@@ -117,6 +117,151 @@ def test_vmap_federation_matches_manual_fedavg():
         )
 
 
+def test_vmap_federation_scaffold_matches_callback_math():
+    """The vectorized SCAFFOLD round: (a) with zero control variates the
+    params equal the plain FedAvg round (corrections are zero on round
+    one), and (b) the post-round variates equal the ScaffoldCallback's
+    Option-II hand math c_i+ = (x - y_i)/(K·lr) with c = mean(c_i+)
+    (callbacks.py:105-124, aggregators/scaffold.py server update)."""
+    n, lr = 2, 0.1
+    kwargs = dict(learning_rate=lr, seed=0)
+    mlp = lambda: MLP(hidden_sizes=(16,), compute_dtype=jnp.float32)
+    fed_avg = VmapFederation(mlp(), n, **kwargs)
+    fed_sc = VmapFederation(mlp(), n, algorithm="scaffold", **kwargs)
+    xs, ys = _node_data(n, n_batches=2, bs=8)
+    xs, ys = jnp.asarray(xs), jnp.asarray(ys)
+
+    # round() donates its params/state buffers: give each federation
+    # its own init (seed-identical).
+    want, _ = fed_avg.round(fed_avg.init_params((28, 28)), xs, ys, epochs=1)
+    params = fed_sc.init_params((28, 28))
+    state = fed_sc.init_scaffold_state(params)
+    got, _aux, (c_locals, c_global), _ = fed_sc.round(
+        params, xs, ys, epochs=1, scaffold_state=state
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(got), jax.tree_util.tree_leaves(want)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+    # Hand math: per-node trained params via the same local SGD.
+    import optax
+
+    module = mlp()
+    opt = optax.sgd(lr, momentum=0.9)
+    variables = module.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 28, 28)), train=False
+    )
+    k_steps = xs.shape[1]  # 1 epoch x n_batches
+    scale = 1.0 / (k_steps * lr)
+    c_manual = []
+    for i in range(n):
+        p = variables["params"]
+        o = opt.init(p)
+        for b in range(xs.shape[1]):
+
+            def loss_of(pp):
+                logits = module.apply({"params": pp}, xs[i, b], train=False)
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits, ys[i, b]
+                ).mean()
+
+            _, grads = jax.value_and_grad(loss_of)(p)
+            updates, o = opt.update(grads, o, p)
+            p = optax.apply_updates(p, updates)
+        c_manual.append(
+            jax.tree_util.tree_map(
+                lambda x0, y_: scale * (x0 - y_), variables["params"], p
+            )
+        )
+    for i in range(n):
+        for got_c, want_c in zip(
+            jax.tree_util.tree_leaves(c_locals),
+            jax.tree_util.tree_leaves(c_manual[i]),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(got_c[i]), np.asarray(want_c),
+                rtol=2e-4, atol=1e-5,
+            )
+    c_mean = jax.tree_util.tree_map(
+        lambda a, b: (a + b) / 2, *c_manual
+    )
+    for got_c, want_c in zip(
+        jax.tree_util.tree_leaves(c_global),
+        jax.tree_util.tree_leaves(c_mean),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(got_c), np.asarray(want_c), rtol=2e-4, atol=1e-5
+        )
+
+
+def test_vmap_federation_scaffold_partial_participation():
+    """Unelected nodes neither move the aggregate nor advance their
+    control variate; the server variate scales by |S|/N."""
+    n = 4
+    fed = VmapFederation(
+        MLP(hidden_sizes=(16,), compute_dtype=jnp.float32), n,
+        algorithm="scaffold", learning_rate=0.1,
+    )
+    params = fed.init_params((28, 28))
+    xs, ys = _node_data(n, n_batches=2, bs=8)
+    weights = jnp.asarray([1.0, 1.0, 0.0, 0.0])
+    state = fed.init_scaffold_state(params)
+    params, _aux, (c_locals, c_global), _ = fed.round(
+        params, jnp.asarray(xs), jnp.asarray(ys), weights=weights,
+        scaffold_state=state,
+    )
+    for leaf in jax.tree_util.tree_leaves(c_locals):
+        leaf = np.asarray(leaf)
+        assert np.abs(leaf[:2]).max() > 0  # elected advanced
+        np.testing.assert_array_equal(leaf[2:], 0)  # unelected frozen
+    # Across further rounds: unelected variates STAY frozen, elected
+    # ones keep moving, the diffused model stays identical across
+    # nodes, and everything stays finite (the correction loop is
+    # stable). (Protocol-path SCAFFOLD convergence is e2e-tested in
+    # test_node.py; at K=2 steps on noise data per-round loss is not
+    # monotone — the variates are 1/(K·lr)-scaled.)
+    state = (c_locals, c_global)
+    for _ in range(2):
+        params, _aux, state, losses = fed.round(
+            params, jnp.asarray(xs), jnp.asarray(ys), weights=weights,
+            scaffold_state=state,
+        )
+    for leaf in jax.tree_util.tree_leaves(state[0]):
+        leaf = np.asarray(leaf)
+        assert np.isfinite(leaf).all()
+        np.testing.assert_array_equal(leaf[2:], 0)
+    for leaf in jax.tree_util.tree_leaves(params):
+        leaf = np.asarray(leaf)
+        assert np.isfinite(leaf).all()
+        np.testing.assert_allclose(leaf[0], leaf[-1])  # diffused
+    assert np.isfinite(np.asarray(losses)).all()
+
+
+def test_vmap_federation_fedprox_pulls_toward_anchor():
+    """FedProx: a large mu keeps the round's aggregate closer to the
+    round-start weights than mu→0 (same data, same steps)."""
+
+    def dist(fed):
+        params = fed.init_params((28, 28))
+        # Snapshot before round() donates the buffers.
+        p0 = [np.asarray(leaf) for leaf in jax.tree_util.tree_leaves(params)]
+        xs, ys = _node_data(2, n_batches=2, bs=8)
+        out, _ = fed.round(params, jnp.asarray(xs), jnp.asarray(ys))
+        sq = 0.0
+        for a, b in zip(jax.tree_util.tree_leaves(out), p0):
+            sq += float(np.sum((np.asarray(a[0]) - b[0]) ** 2))
+        return sq
+
+    mk = lambda **kw: VmapFederation(
+        MLP(hidden_sizes=(16,), compute_dtype=jnp.float32), 2,
+        learning_rate=0.1, **kw,
+    )
+    d_avg = dist(mk())
+    d_prox = dist(mk(algorithm="fedprox", prox_mu=10.0))
+    assert d_prox < d_avg * 0.9, (d_prox, d_avg)
+
+
 def test_sharded_trainer_dp_and_fsdp():
     mesh = create_mesh({"dp": 8})
     for fsdp in (False, True):
@@ -540,7 +685,7 @@ def test_flash_kernel_gradients_unaligned_causal():
 
 def test_transformer_lm_with_ring_attention_seam():
     """TransformerLM's attention_fn seam: the same model computes
-    identical logits with default blockwise attention and with
+    matching logits with default blockwise attention and with
     sequence-parallel ring attention over the 8-device mesh."""
     from tpfl.models import create_model
     from tpfl.parallel import make_ring_attention
@@ -562,8 +707,14 @@ def test_transformer_lm_with_ring_attention_seam():
         vocab=32, dim=32, heads=2, n_layers=1, attention_fn=ring,
     )
     ringed = ring_module.apply({"params": model.get_parameters()}, tokens)
+    # bf16-honest tolerance: the model computes in bf16, and the two
+    # attention inners round at different points — blockwise's score
+    # einsum on bf16 inputs yields bf16 scores, the flash-ring kernel
+    # keeps scores f32 (strictly more accurate) — so logits agree to
+    # bf16 resolution, not f32. The f32 exactness of the ring itself
+    # is pinned by test_ring_attention_matches_dense (atol 2e-5).
     np.testing.assert_allclose(
-        np.asarray(ringed), np.asarray(base), atol=2e-4
+        np.asarray(ringed), np.asarray(base), atol=4e-2
     )
     with pytest.raises(ValueError, match="causal"):
         make_ring_attention(mesh, causal=False)(

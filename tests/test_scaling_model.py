@@ -144,6 +144,140 @@ def test_fsdp_collective_bytes_independent_of_batch():
     assert byts[1] <= 1.25 * byts[0], byts
 
 
+def test_ring_attention_permute_bytes_are_local_block_sized():
+    """sp tier: the ring's ppermute moves O(local KV block) per hop —
+    at fixed global S the permuted bytes fall 1/d, never O(S) (the
+    fwd rotates k+v; the recompute VJP rotates k, v, dk, dv)."""
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+
+    from tpfl.parallel.ring_attention import ring_attention
+    from tpfl.parallel.scaling import collective_bytes
+
+    B, S, H, D = 1, 64, 2, 8
+    rng = np.random.default_rng(0)
+    qkv = [
+        jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+        for _ in range(3)
+    ]
+    spec = P(None, "sp", None, None)
+    seen = {}
+    for d in (2, 4, 8):
+        mesh = create_mesh({"sp": d}, devices=jax.devices()[:d])
+        ring = jax.shard_map(
+            partial(ring_attention, axis_name="sp", causal=True),
+            mesh=mesh, in_specs=(spec,) * 3, out_specs=spec,
+            check_vma=False,
+        )
+
+        def loss(q, k, v):
+            return jnp.sum(ring(q, k, v).astype(jnp.float32) ** 2)
+
+        compiled = (
+            jax.jit(jax.grad(loss, argnums=(0, 1, 2))).lower(*qkv).compile()
+        )
+        pb = collective_bytes(compiled.as_text()).get(
+            "collective-permute", 0
+        )
+        local_block = B * (S // d) * H * D * 4
+        assert 0 < pb <= 12 * local_block, (d, pb, local_block)
+        seen[d] = pb
+    # 1/d shape: widths differ, so per-hop bytes must differ too
+    # (within HLO-duplication slack) — an O(S) hop would be flat.
+    assert seen[8] < seen[2], seen
+
+
+def test_pipeline_permute_hop_size_independent_of_microbatch_count():
+    """pp tier: each collective-permute hop carries ONE microbatch
+    activation — total permute bytes are O(ticks x microbatch) (totals
+    are conserved under XLA's unrolling of the short tick scan and its
+    collective-combiner merging the unrolled hops), so the per-tick
+    quotient is the per-hop payload and must not grow with the
+    microbatch count."""
+    from tpfl.parallel.pipeline import make_pipeline_trainer
+    from tpfl.parallel.scaling import collective_bytes
+
+    n_stages = 4
+    mesh = create_mesh({"pp": n_stages}, devices=jax.devices()[:n_stages])
+    rng = np.random.default_rng(0)
+    w = rng.normal(0, 0.3, (8, 8, 8)).astype(np.float32)
+    mb_bytes = 2 * 8 * 4  # [2, 8] f32 activation
+    hops = {}
+    for n_micro in (4, 8):
+        init, step = make_pipeline_trainer(
+            mesh,
+            lambda p, x: x + jnp.tanh(x @ p["w"]),
+            n_layers=8,
+            loss_fn=lambda out, tgt: jnp.mean((out - tgt) ** 2),
+        )
+        params, opt = init({"w": jnp.asarray(w)})
+        micro = jnp.asarray(
+            rng.normal(size=(n_micro, 2, 8)).astype(np.float32)
+        )
+        compiled = step.lower(params, opt, micro, micro).compile()
+        total = collective_bytes(compiled.as_text()).get(
+            "collective-permute", 0
+        )
+        ticks = 2 * (n_micro + n_stages - 1)  # fwd + bwd replay
+        hops[n_micro] = total / ticks
+        assert 0 < hops[n_micro] <= 2 * mb_bytes, (n_micro, hops, mb_bytes)
+    assert hops[8] <= 1.5 * hops[4], hops
+
+
+def test_moe_all_to_all_bytes_are_dispatch_buffer_sized():
+    """ep tier: the all-to-all swaps the [n, C, D] dispatch buffer
+    (two passes) — O(local tokens·dim), never O(tokens·experts·dim)
+    (which would show as an extra factor of n)."""
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+
+    from tpfl.parallel.moe import moe_dispatch
+    from tpfl.parallel.scaling import collective_bytes
+
+    cap, dim = 4, 8
+    rng = np.random.default_rng(0)
+    for d in (2, 4, 8):
+        mesh = create_mesh({"ep": d}, devices=jax.devices()[:d])
+        moe = jax.shard_map(
+            partial(
+                moe_dispatch,
+                expert_fn=lambda t: t * 2.0,
+                capacity=cap,
+                axis_name="ep",
+            ),
+            mesh=mesh, in_specs=(P("ep"), P("ep")), out_specs=P("ep"),
+            check_vma=False,
+        )
+        toks = jnp.asarray(
+            rng.normal(size=(4 * d, dim)).astype(np.float32)
+        )
+        eo = jnp.asarray(rng.integers(0, d, size=(4 * d,)).astype(np.int32))
+        compiled = jax.jit(moe).lower(toks, eo).compile()
+        ab = collective_bytes(compiled.as_text()).get("all-to-all", 0)
+        buf = d * cap * dim * 4
+        assert 0 < ab <= 4 * buf, (d, ab, buf)
+
+
+def test_federation_learner_dcn_bytes_independent_of_local_nodes():
+    """Hierarchical tier: each outer host puts ONE O(params) model on
+    the wire per round — quadrupling the vmapped local node count must
+    change neither the max message payload nor (beyond gossip-timing
+    slack) the total weight bytes (__graft_entry__'s DCN verdict)."""
+    import __graft_entry__ as ge
+
+    dcn = ge._dcn_wire_bytes_per_round(local_nodes=(2, 8))
+    pbytes = next(iter(dcn.values()))["params_bytes"]
+    payloads = [v["max_payload"] for v in dcn.values()]
+    totals = [v["weights_bytes"] for v in dcn.values()]
+    # A few METADATA bytes may differ (msgpack varints of num_samples);
+    # weight bytes may not.
+    assert max(payloads) - min(payloads) <= 64, dcn
+    assert 0 < max(payloads) <= 3 * pbytes, dcn
+    assert max(totals) <= 3 * min(totals), dcn
+
+
 def test_fsdp_aux_step_collective_bytes_independent_of_batch():
     """The BatchNorm-threading step (train_step_with_aux) must carry the
     same ZeRO-3 property as the plain step: parameter traffic only —

@@ -1,0 +1,93 @@
+"""Scratch 10: TPU end-to-end vmapped train step with Pallas-backward
+convs vs XLA baseline (22.03 ms), plus numeric sanity on-chip."""
+import os
+import time
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir", os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+
+from tpfl.models import CNN
+
+rng = np.random.default_rng(0)
+PEAK = 197e12
+N, BS = 100, 128
+R = 20
+
+
+def rtt():
+    @jax.jit
+    def run(x):
+        return lax.fori_loop(0, 100, lambda i, a: a + x * (1 + i), jnp.float32(0))
+
+    float(run(jnp.float32(1)))
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(run(jnp.float32(1)))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+BASE = rtt()
+print(f"RTT baseline: {BASE*1e3:.1f} ms", flush=True)
+
+x_dev = jnp.asarray(rng.normal(size=(N, BS, 32, 32, 3)), jnp.bfloat16)
+y_dev = jnp.asarray(rng.integers(0, 10, (N, BS)), jnp.int32)
+fs = (32 * 32 * 9 * 3 * 32 + 16 * 16 * 9 * 32 * 64 + 4096 * 128 + 128 * 10) * 2
+f_step = 3 * fs * N * BS
+
+
+def measure(tag, module):
+    variables = module.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), train=False)
+    p1 = variables["params"]
+    params = jax.tree_util.tree_map(
+        lambda q: jnp.broadcast_to(q[None], (N, *q.shape)) + 0, p1)
+    opt = optax.sgd(0.1, momentum=0.9)
+    opt_state = jax.vmap(opt.init)(params)
+
+    def one(pp, oo, xx, yy):
+        def loss_of(q):
+            logits = module.apply({"params": q}, xx, train=False)
+            return optax.softmax_cross_entropy_with_integer_labels(logits, yy).mean()
+
+        loss, grads = jax.value_and_grad(loss_of)(pp)
+        up, oo = opt.update(grads, oo, pp)
+        return optax.apply_updates(pp, up), oo, loss
+
+    def step(t, i):
+        p, o, _ = t
+        return jax.vmap(one)(p, o, x_dev, y_dev)
+
+    @jax.jit
+    def run(t):
+        return lax.fori_loop(0, R, lambda i, t: step(t, i), t)
+
+    t0 = (params, opt_state, jnp.zeros((N,), jnp.float32))
+    out = run(t0)
+    losses = np.asarray(out[2])
+    best = float("inf")
+    for _ in range(3):
+        tt = time.perf_counter()
+        out = run(t0)
+        float(np.asarray(out[2]).mean())
+        best = min(best, time.perf_counter() - tt)
+    per = (best - BASE) / R
+    print(f"{tag}: {per*1e3:.2f} ms  ({f_step/per/PEAK*100:.1f}% MFU)  "
+          f"loss[:3]={np.asarray(out[2])[:3]}", flush=True)
+    return out
+
+
+out_p = measure("pallas-bwd step", CNN(out_channels=10, conv_impl="pallas"))
+out_x = measure("xla-bwd step   ", CNN(out_channels=10, conv_impl="xla"))
+# same trajectory? params after R steps should agree to bf16 tolerance
+pa = jax.tree_util.tree_leaves(out_p[0])
+px = jax.tree_util.tree_leaves(out_x[0])
+errs = [float(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32)).max()) for a, b in zip(pa, px)]
+print("max param divergence after 20 steps:", max(errs), flush=True)

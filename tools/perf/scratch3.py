@@ -1,0 +1,83 @@
+"""Scratch 3: trustworthy timing (mean->float sync, iter scaling check)
++ lane-padding layout theory tests."""
+import os
+import time
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir", os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+rng = np.random.default_rng(0)
+PEAK = 197e12
+NB = 12800
+
+
+def timeit(fn, *args, n=10, tag="", flops=None, bytes_=None):
+    """fn must return a SCALAR-reducible array; sync via float(mean)."""
+    out = fn(*args)
+    float(jnp.asarray(out).mean())  # compile + sync
+    for reps in (n, 3 * n):
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(*args)
+        float(jnp.asarray(out).mean())
+        dt = (time.perf_counter() - t0) / reps
+        msg = f"{tag} (reps={reps}): {dt*1e3:.2f} ms"
+        if flops:
+            msg += f"  ({flops/dt/PEAK*100:.1f}% MFU)"
+        if bytes_:
+            msg += f"  ({bytes_/dt/1e9:.0f} GB/s)"
+        print(msg, flush=True)
+    return dt
+
+
+K = 3
+# 1) relu on [NB,32,32,3] (lane-padded 43x?) vs same data as [NB,32,96] (dense lanes)
+x_pad = jnp.asarray(rng.normal(size=(NB, 32, 32, 3)), jnp.bfloat16)
+x_dense = jnp.asarray(rng.normal(size=(NB, 32, 96)), jnp.bfloat16)
+nbytes = NB * 32 * 32 * 3 * 2
+timeit(jax.jit(lambda x: jax.nn.relu(x).mean(axis=(1, 2, 3))), x_pad,
+       tag="relu NHWC C=3   ", bytes_=2 * nbytes)
+timeit(jax.jit(lambda x: jax.nn.relu(x).mean(axis=(1, 2))), x_dense,
+       tag="relu dense lanes", bytes_=2 * nbytes)
+
+# 2) conv1 fwd with mean-reduced output (sync honest)
+w1 = jnp.asarray(rng.normal(size=(K, K, 3, 32)), jnp.bfloat16)
+f1 = NB * 32 * 32 * K * K * 3 * 32 * 2
+conv = lambda x, w: lax.conv_general_dilated(
+    x, w, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+timeit(jax.jit(lambda x, w: conv(x, w).mean(axis=(1, 2, 3))), x_pad, w1,
+       tag="conv1 fwd       ", flops=f1)
+
+# 3) conv2 fwd
+x2 = jnp.asarray(rng.normal(size=(NB, 16, 16, 32)), jnp.bfloat16)
+w2 = jnp.asarray(rng.normal(size=(K, K, 32, 64)), jnp.bfloat16)
+f2 = NB * 16 * 16 * K * K * 32 * 64 * 2
+timeit(jax.jit(lambda x, w: conv(x, w).mean(axis=(1, 2, 3))), x2, w2,
+       tag="conv2 fwd       ", flops=f2)
+
+# 4) batched GEMM conv2-shape with honest sync
+N, M2, P2, C2 = 100, 32768, 288, 64
+pa = jnp.asarray(rng.normal(size=(N, M2, P2)), jnp.bfloat16)
+wb = jnp.asarray(rng.normal(size=(N, P2, C2)), jnp.bfloat16)
+fb = 2 * N * M2 * P2 * C2
+timeit(jax.jit(lambda a, b: lax.dot_general(
+    a, b, (((2,), (1,)), ((0,), (0,)))).mean(axis=(1, 2))), pa, wb,
+    tag="batched GEMM    ", flops=fb)
+
+# 5) single big GEMM [N*M2, P2] @ [P2, 128] — MXU sanity ceiling
+pf = pa.reshape(N * M2, P2)
+wfat = jnp.asarray(rng.normal(size=(P2, 128)), jnp.bfloat16)
+timeit(jax.jit(lambda a, b: (a @ b).mean(axis=1)), pf, wfat,
+       tag="GEMM K288 N128  ", flops=2 * N * M2 * P2 * 128)
+
+# 6) big square-ish GEMM: true MXU peak check
+A = jnp.asarray(rng.normal(size=(8192, 4096)), jnp.bfloat16)
+Bm = jnp.asarray(rng.normal(size=(4096, 8192)), jnp.bfloat16)
+timeit(jax.jit(lambda a, b: (a @ b).mean(axis=1)), A, Bm,
+       tag="GEMM 8k/4k/8k   ", flops=2 * 8192 * 4096 * 8192)

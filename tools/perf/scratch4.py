@@ -1,0 +1,90 @@
+"""Scratch 4: device-side repetition (fori inside jit) — dispatch-proof
+timing. acc-dependency serializes iterations; input scaled by (1+i*eps)
+so XLA cannot hoist the op out of the loop."""
+import os
+import time
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir", os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+rng = np.random.default_rng(0)
+PEAK = 197e12
+NB = 12800
+R = 20  # device-side reps
+
+
+def devtime(make_body, *args, tag="", flops=None, bytes_=None):
+    """make_body(i, *args) -> scalar. Times R serialized device iters."""
+
+    @jax.jit
+    def run(*a):
+        def body(i, acc):
+            return acc + make_body(i, *a)
+
+        return lax.fori_loop(0, R, body, jnp.float32(0))
+
+    float(run(*args))  # compile + warm
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(run(*args))
+        best = min(best, (time.perf_counter() - t0) / R)
+    msg = f"{tag}: {best*1e3:.3f} ms"
+    if flops:
+        msg += f"  ({flops/best/PEAK*100:.1f}% MFU)"
+    if bytes_:
+        msg += f"  ({bytes_/best/1e9:.0f} GB/s)"
+    print(msg, flush=True)
+    return best
+
+
+# dispatch latency calibration: trivial op
+devtime(lambda i, x: (x[0, 0, 0, 0] * (1 + i)).astype(jnp.float32),
+        jnp.ones((1, 1, 1, 1), jnp.bfloat16), tag="empty-ish       ")
+
+K = 3
+conv = lambda x, w: lax.conv_general_dilated(
+    x, w, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+x1 = jnp.asarray(rng.normal(size=(NB, 32, 32, 3)), jnp.bfloat16)
+w1 = jnp.asarray(rng.normal(size=(K, K, 3, 32)), jnp.bfloat16)
+f1 = NB * 32 * 32 * K * K * 3 * 32 * 2
+nbytes1 = NB * 32 * 32 * 3 * 2
+
+devtime(lambda i, x: jax.nn.relu(x * (1 + 1e-6 * i)).mean().astype(jnp.float32),
+        x1, tag="relu C=3        ", bytes_=2 * nbytes1)
+devtime(lambda i, x, w: conv(x * (1 + 1e-6 * i), w).mean().astype(jnp.float32),
+        x1, w1, tag="conv1 fwd       ", flops=f1)
+
+x2 = jnp.asarray(rng.normal(size=(NB, 16, 16, 32)), jnp.bfloat16)
+w2 = jnp.asarray(rng.normal(size=(K, K, 32, 64)), jnp.bfloat16)
+f2 = NB * 16 * 16 * K * K * 32 * 64 * 2
+devtime(lambda i, x, w: conv(x * (1 + 1e-6 * i), w).mean().astype(jnp.float32),
+        x2, w2, tag="conv2 fwd       ", flops=f2)
+
+# batched GEMM conv2-shape
+N, M2, P2, C2 = 100, 32768, 288, 64
+pa = jnp.asarray(rng.normal(size=(N, M2, P2)), jnp.bfloat16)
+wb = jnp.asarray(rng.normal(size=(N, P2, C2)), jnp.bfloat16)
+fb = 2 * N * M2 * P2 * C2
+devtime(lambda i, a, b: lax.dot_general(
+    a * (1 + 1e-6 * i), b, (((2,), (1,)), ((0,), (0,)))).mean().astype(jnp.float32),
+    pa, wb, tag="batched GEMM    ", flops=fb)
+
+# single GEMM, K=288 N=128
+pf = pa.reshape(N * M2, P2)
+wfat = jnp.asarray(rng.normal(size=(P2, 128)), jnp.bfloat16)
+devtime(lambda i, a, b: ((a * (1 + 1e-6 * i)) @ b).mean().astype(jnp.float32),
+        pf, wfat, tag="GEMM K288 N128  ", flops=2 * N * M2 * P2 * 128)
+
+# MXU peak sanity
+A = jnp.asarray(rng.normal(size=(8192, 4096)), jnp.bfloat16)
+Bm = jnp.asarray(rng.normal(size=(4096, 8192)), jnp.bfloat16)
+devtime(lambda i, a, b: ((a * (1 + 1e-6 * i)) @ b).mean().astype(jnp.float32),
+        A, Bm, tag="GEMM 8k/4k/8k   ", flops=2 * 8192 * 4096 * 8192)

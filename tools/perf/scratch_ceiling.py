@@ -1,0 +1,77 @@
+"""Scratch: component ceilings for the 100-node CNN round on one v5e chip."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+rng = np.random.default_rng(0)
+N, B, H, W, Cin, C1, C2, K = 100, 128, 32, 32, 3, 32, 64, 3
+PEAK = 197e12
+
+
+def timeit(fn, *args, n=10, tag="", flops=None):
+    out = jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / n
+    msg = f"{tag}: {dt*1e3:.2f} ms"
+    if flops:
+        msg += f"  ({flops/dt/PEAK*100:.1f}% MFU)"
+    print(msg)
+    return dt
+
+
+# (a) shared-weight net, nodes folded into batch — the ceiling
+x_big = jnp.asarray(rng.normal(size=(N * B, H, W, Cin)), jnp.bfloat16)
+w1s = jnp.asarray(rng.normal(size=(K, K, Cin, C1)), jnp.bfloat16)
+w2s = jnp.asarray(rng.normal(size=(K, K, C1, C2)), jnp.bfloat16)
+
+
+def net_shared(x, wa, wb):
+    y = lax.conv_general_dilated(x, wa, (1, 1), "SAME",
+                                 dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    y = jax.nn.relu(y)
+    y = lax.reduce_window(y, -jnp.inf, lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID")
+    y = lax.conv_general_dilated(y, wb, (1, 1), "SAME",
+                                 dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return y
+
+
+f_fwd = N * B * (H * W * K * K * Cin * C1 + (H // 2) * (W // 2) * K * K * C1 * C2) * 2
+
+g_shared = jax.jit(jax.grad(lambda wa, wb: jnp.sum(net_shared(x_big, wa, wb).astype(jnp.float32) ** 2), argnums=(0, 1)))
+timeit(g_shared, w1s, w2s, tag="shared-weight fwd+bwd", flops=3 * f_fwd)
+
+fwd_shared = jax.jit(lambda wa, wb: net_shared(x_big, wa, wb))
+timeit(fwd_shared, w1s, w2s, tag="shared-weight fwd    ", flops=f_fwd)
+
+# (b) batched GEMM alone, conv2 shape: [N, M2, P2] @ [N, P2, C2]
+M2, P2 = B * (H // 2) * (W // 2), K * K * C1
+pa = jnp.asarray(rng.normal(size=(N, M2, P2)), jnp.bfloat16)
+wb2 = jnp.asarray(rng.normal(size=(N, P2, C2)), jnp.bfloat16)
+bg = jax.jit(lambda a, b: lax.dot_general(a, b, (((2,), (1,)), ((0,), (0,)))))
+timeit(bg, pa, wb2, tag="batched GEMM conv2   ", flops=2 * N * M2 * P2 * C2)
+
+# conv1-shaped batched GEMM: [N, B*H*W, 27] @ [N, 27, 32]
+M1, P1 = B * H * W, K * K * Cin
+pa1 = jnp.asarray(rng.normal(size=(N, M1, P1)), jnp.bfloat16)
+wb1 = jnp.asarray(rng.normal(size=(N, P1, C1)), jnp.bfloat16)
+timeit(bg, pa1, wb1, tag="batched GEMM conv1   ", flops=2 * N * M1 * P1 * C1)
+
+# (c) patch extraction alone (both convs), node-folded
+ex1 = jax.jit(lambda x: lax.conv_general_dilated_patches(
+    x, (K, K), (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC")))
+timeit(ex1, x_big, tag="patches conv1        ")
+y_mid = jnp.asarray(rng.normal(size=(N * B, H // 2, W // 2, C1)), jnp.bfloat16)
+timeit(ex1, y_mid, tag="patches conv2        ")
+
+# (d) grouped-conv lowering of the vmapped conv2 (what XLA does today)
+xs2 = jnp.asarray(rng.normal(size=(N, B, H // 2, W // 2, C1)), jnp.bfloat16)
+w2b = jnp.asarray(rng.normal(size=(N, K, K, C1, C2)), jnp.bfloat16)
+vc = jax.jit(jax.vmap(lambda x, w: lax.conv_general_dilated(
+    x, w, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))))
+timeit(vc, xs2, w2b, tag="vmapped conv2 (XLA)  ", flops=2 * N * M2 * P2 * C2)

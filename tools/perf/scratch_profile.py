@@ -1,0 +1,95 @@
+"""Scratch: op-level breakdown of the CNN fwd path + GEMM variants.
+
+Sync discipline: block_until_ready is unreliable under the axon plugin —
+every measurement syncs by pulling one element to host (D2H waits for
+the producing program; device executes launches in order, so the final
+sync drains the whole queue).
+"""
+import os
+import time
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir", os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+rng = np.random.default_rng(0)
+N, B, H, W, Cin, C1, C2, K = 100, 128, 32, 32, 3, 32, 64, 3
+PEAK = 197e12
+NB = N * B
+
+
+def sync(out):
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    np.asarray(leaf.ravel()[:1])
+
+
+def timeit(fn, *args, n=10, tag="", flops=None):
+    sync(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    sync(out)
+    dt = (time.perf_counter() - t0) / n
+    msg = f"{tag}: {dt*1e3:.2f} ms"
+    if flops:
+        msg += f"  ({flops/dt/PEAK*100:.1f}% MFU)"
+    print(msg, flush=True)
+    return dt
+
+
+x1 = jnp.asarray(rng.normal(size=(NB, H, W, Cin)), jnp.bfloat16)
+w1 = jnp.asarray(rng.normal(size=(K, K, Cin, C1)), jnp.bfloat16)
+x2 = jnp.asarray(rng.normal(size=(NB, H // 2, W // 2, C1)), jnp.bfloat16)
+w2 = jnp.asarray(rng.normal(size=(K, K, C1, C2)), jnp.bfloat16)
+
+conv = lambda x, w: lax.conv_general_dilated(
+    x, w, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+f1 = NB * H * W * K * K * Cin * C1 * 2
+f2 = NB * (H // 2) * (W // 2) * K * K * C1 * C2 * 2
+
+timeit(jax.jit(conv), x1, w1, tag="conv1 fwd alone      ", flops=f1)
+timeit(jax.jit(conv), x2, w2, tag="conv2 fwd alone      ", flops=f2)
+
+pool = lambda y: lax.reduce_window(y, -jnp.inf, lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID")
+y1 = jnp.asarray(rng.normal(size=(NB, H, W, C1)), jnp.bfloat16)
+timeit(jax.jit(lambda y: pool(jax.nn.relu(y))), y1, tag="relu+pool on conv1out")
+
+# whole shared-weight 2-conv fwd, for a consistent baseline with D2H sync
+def net_shared(x, wa, wb):
+    y = conv(x, wa)
+    y = jax.nn.relu(y)
+    y = pool(y)
+    return conv(y, wb)
+
+timeit(jax.jit(net_shared), x1, w1, w2, tag="shared net fwd       ", flops=f1 + f2)
+g_sh = jax.jit(jax.grad(lambda wa, wb: jnp.sum(net_shared(x1, wa, wb).astype(jnp.float32) ** 2), argnums=(0, 1)))
+timeit(g_sh, w1, w2, tag="shared net fwd+bwd   ", flops=3 * (f1 + f2))
+
+# GEMM variants for conv2 shape
+M2, P2 = B * (H // 2) * (W // 2), K * K * C1
+pa = jnp.asarray(rng.normal(size=(N, M2, P2)), jnp.bfloat16)
+wb = jnp.asarray(rng.normal(size=(N, P2, C2)), jnp.bfloat16)
+fb = 2 * N * M2 * P2 * C2
+
+timeit(jax.jit(lambda a, b: lax.dot_general(a, b, (((2,), (1,)), ((0,), (0,))))),
+       pa, wb, tag="batched GEMM n-major ", flops=fb)
+
+pa_flat = pa.reshape(N * M2, P2)
+wb1 = wb[0]
+timeit(jax.jit(lambda a, b: a @ b), pa_flat, wb1, tag="single GEMM shared   ", flops=fb)
+
+try:
+    gs = jnp.full((N,), M2, jnp.int32)
+    timeit(jax.jit(lambda a, b, g: lax.ragged_dot(a, b, g)), pa_flat, wb, gs,
+           tag="ragged_dot           ", flops=fb)
+except Exception as e:
+    print("ragged_dot failed:", str(e)[:200], flush=True)
+
+wb128 = jnp.concatenate([wb1, wb1], 1)
+timeit(jax.jit(lambda a, b: a @ b), pa_flat, wb128, tag="single GEMM N=128    ", flops=2 * fb)

@@ -13,13 +13,55 @@ synchronization points.
 
 from __future__ import annotations
 
+import queue
+import threading
 from abc import ABC, abstractmethod
-from typing import Any, TYPE_CHECKING
+from typing import Any, Callable, Optional, TYPE_CHECKING
 
 from tpfl.management.logger import logger
 
 if TYPE_CHECKING:
     from tpfl.node import Node
+
+
+class _DaemonPool:
+    """Shared bounded pool for epidemic FullModel relays (all
+    in-process nodes): each relay is short-lived (a handful of
+    verbatim re-sends), so a few workers drain the whole diffusion
+    wave without the thread-per-adoption burst. DAEMON workers — not
+    ThreadPoolExecutor, whose non-daemon threads are joined at
+    interpreter exit: relays are best-effort, and a queued diffusion
+    backlog must never block process shutdown."""
+
+    def __init__(self, workers: int = 8) -> None:
+        self._q: "queue.SimpleQueue[Callable[[], None]]" = queue.SimpleQueue()
+        for i in range(workers):
+            threading.Thread(
+                target=self._run, daemon=True, name=f"tpfl-relay-{i}"
+            ).start()
+
+    def _run(self) -> None:
+        while True:
+            job = self._q.get()
+            try:
+                job()
+            except Exception:  # best-effort; jobs log their own errors
+                pass
+
+    def submit(self, job: Callable[[], None]) -> None:
+        self._q.put(job)
+
+
+_relay_pool_lock = threading.Lock()
+_relay_pool_inst: Optional[_DaemonPool] = None
+
+
+def _relay_pool() -> _DaemonPool:
+    global _relay_pool_inst
+    with _relay_pool_lock:
+        if _relay_pool_inst is None:
+            _relay_pool_inst = _DaemonPool(workers=8)
+        return _relay_pool_inst
 
 
 class Command(ABC):
@@ -54,7 +96,8 @@ class StartLearningCommand(NodeCommand):
     def execute(self, source: str, round: int, args: list[str], **kwargs: Any) -> None:
         rounds, epochs = int(args[0]), int(args[1])
         exp_name = args[2] if len(args) > 2 else "experiment"
-        self.node.start_learning_thread(rounds, epochs, exp_name)
+        beacon = args[3] if len(args) > 3 else ""
+        self.node.start_learning_thread(rounds, epochs, exp_name, beacon=beacon)
 
 
 class StopLearningCommand(NodeCommand):
@@ -345,6 +388,7 @@ class FullModelCommand(NodeCommand):
         except Exception as e:
             logger.error(st.addr, f"FullModel decode failed: {e}")
             return
+        st.model_version += 1
         st.last_full_model_round = max(st.last_full_model_round, round)
         st.aggregated_model_event.set()
         # At-most-once per (node, round), atomically — concurrent
@@ -360,9 +404,10 @@ class FullModelCommand(NodeCommand):
             # so an inline relay would recurse one level per hop (a
             # LINE/RING wave overflows the interpreter's recursion
             # limit), and on gRPC it would hold a server worker through
-            # many large sends.
-            import threading
-
+            # many large sends. Relays share one BOUNDED pool: a fresh
+            # thread per adoption was a ~N-thread burst per round in
+            # the N-node in-process simulation (GIL pressure during
+            # the diffusion wave on a single-core host).
             node = self.node
 
             def _relay() -> None:
@@ -393,9 +438,7 @@ class FullModelCommand(NodeCommand):
                 except Exception as e:  # relay is best-effort
                     logger.debug(st.addr, f"FullModel relay failed: {e}")
 
-            threading.Thread(
-                target=_relay, daemon=True, name=f"relay-{st.addr}"
-            ).start()
+            _relay_pool().submit(_relay)
         if not st.model_initialized_event.is_set():
             # A round's aggregate is an authoritative model for this
             # experiment: a straggler still blocked waiting for init

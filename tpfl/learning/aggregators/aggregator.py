@@ -56,6 +56,13 @@ class Aggregator(ABC):
         self._lock = threading.Lock()
         self._finish_aggregation_event = threading.Event()
         self._finish_aggregation_event.set()
+        # Bumped on every state change (round start/end, model added).
+        # Gossip loops key their encoded-payload caches on it: between
+        # changes, a partial aggregate for the same except-set is
+        # byte-identical, and re-running the jitted aggregation + the
+        # device->host transfer + msgpack encode per push tick was the
+        # measured formation bottleneck at 1000 single-core nodes.
+        self.version = 0
 
     # --- math (subclasses) ---
 
@@ -85,6 +92,7 @@ class Aggregator(ABC):
         with self._lock:
             self._train_set = list(nodes)
             self._models = []
+            self.version += 1
             # Clear under the lock: a model arriving between the train-set
             # assignment and the clear would otherwise see the event still
             # set in add_model and be dropped at round start.
@@ -100,6 +108,7 @@ class Aggregator(ABC):
         with self._lock:
             self._train_set = []
             self._models = []
+            self.version += 1
         self._finish_aggregation_event.set()
 
     # --- model intake ---
@@ -153,6 +162,7 @@ class Aggregator(ABC):
                 )
                 return []
             self._models.append(model)
+            self.version += 1
             covered |= set(contributors)
             logger.debug(
                 self.node_name,

@@ -99,6 +99,7 @@ class Node:
         self.rounds: int = 0
         self.epochs: int = 1
         self.exp_name: str = "experiment"
+        self.beacon: str = ""
         # Name of the last experiment that ran to completion HERE —
         # the evidence InitModelRequestCommand requires before serving
         # "finished" weights to a straggler (set by RoundFinishedStage).
@@ -163,9 +164,21 @@ class Node:
         if self.state.status == "Learning":
             raise LearnerRunningException("Already learning")
         exp_name = f"experiment_{uuid.uuid4().hex[:8]}"
+        # Election beacon: a per-experiment shared random value every
+        # participant learns WITH the experiment announcement, mixed
+        # into the hash-election rank (Settings.ELECTION docs). Derived
+        # from the initiator's init-model bytes, so it is not known
+        # before the experiment exists — an adversary must commit its
+        # address before the beacon is revealed to grind the election.
+        import hashlib
+
+        beacon = hashlib.sha256(
+            self.learner.get_model().encode_parameters()
+        ).hexdigest()
         self.communication.broadcast(
             self.communication.build_msg(
-                StartLearningCommand.name, [str(rounds), str(epochs), exp_name]
+                StartLearningCommand.name,
+                [str(rounds), str(epochs), exp_name, beacon],
             )
         )
         # Initiator has the weights: release its own init event and
@@ -176,11 +189,15 @@ class Node:
         self.communication.broadcast(
             self.communication.build_msg(ModelInitializedCommand.name)
         )
-        self.start_learning_thread(rounds, epochs, exp_name)
+        self.start_learning_thread(rounds, epochs, exp_name, beacon=beacon)
         return exp_name
 
     def start_learning_thread(
-        self, rounds: int, epochs: int, exp_name: str = "experiment"
+        self,
+        rounds: int,
+        epochs: int,
+        exp_name: str = "experiment",
+        beacon: str = "",
     ) -> None:
         """Spawn the stage-workflow thread (also the StartLearningCommand
         entry point for non-initiator nodes)."""
@@ -190,6 +207,12 @@ class Node:
         self.rounds = rounds
         self.epochs = epochs
         self.exp_name = exp_name
+        self.beacon = beacon
+        # A new run invalidates the previous run's "finished" evidence:
+        # if exp_name is reused, a straggler's InitModelRequest during
+        # the pre-Learning window must NOT be served the old final
+        # weights (common-init violation).
+        self.completed_experiment = None
         self.state.prepare_experiment()
         self.learning_workflow = LearningWorkflow()
         self._learning_thread = threading.Thread(

@@ -46,6 +46,13 @@ class NodeState:
         Check-and-mark happens under ``relay_lock`` — concurrent
         deliveries of the same round from two peers (gRPC handler pool)
         must not both fan the payload out."""
+        self.model_version: int = 0
+        """Bumped whenever an incoming FullModelCommand replaces the
+        learner's model. GossipModelStage keys its encoded-payload
+        cache on it: a round's AUTHORITATIVE aggregate can land while
+        the stage is mid-push (the node entered holding a timed-out
+        partial aggregate), and the cached stale bytes must not keep
+        flowing."""
 
         # Gossip bookkeeping
         self.models_aggregated: dict[str, list[str]] = {}

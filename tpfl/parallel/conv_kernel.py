@@ -52,11 +52,13 @@ from jax.experimental.pallas import tpu as pltpu
 _DN = ("NHWC", "HWIO", "NHWC")
 
 
-def _pick_bb(b: int, h: int, w: int, cin: int, cout: int) -> int:
+def _pick_bb(b: int, h: int, w: int, cin: int, cout: int, k: int,
+             itemsize: int) -> int:
     """Images per grid step: bound the in-kernel patch matrix to ~2.5 MB
-    of VMEM ([bb·h·w, k²·max(cin,cout)] bf16) and divide the batch."""
+    of VMEM ([bb·h·w, k²·max(cin,cout)] at the input itemsize) and
+    divide the batch."""
     budget = 2_500_000
-    per_img = h * w * 9 * max(cin, cout) * 2
+    per_img = h * w * k * k * max(cin, cout) * itemsize
     bb = max(1, min(b, budget // max(per_img, 1)))
     while b % bb:
         bb -= 1
@@ -138,7 +140,7 @@ def _nc_bwd(interpret, res, g):
     k, k2, _, cout = w.shape
     assert k == k2 and k % 2 == 1, "NodeConv: odd square kernels only"
     g = g.astype(x.dtype)
-    bb = _pick_bb(b, h, w_, cin, cout)
+    bb = _pick_bb(b, h, w_, cin, cout, k, jnp.dtype(x.dtype).itemsize)
     grid = (b // bb,)
     halo = k - 1
 

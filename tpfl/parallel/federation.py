@@ -50,6 +50,25 @@ def _masked_leaf_mean(weights: Any) -> Callable[[Any], Any]:
     return leaf_mean
 
 
+def _make_prox(algorithm: str, mu: float) -> Callable[[Any, Any], Any]:
+    """FedProx proximal term ``mu/2·||p - p0||²`` (0 for other
+    algorithms — returning a constant 0.0 keeps the default round
+    program free of the dead subtraction tree)."""
+    if algorithm != "fedprox":
+        return lambda p, p0: 0.0
+
+    def prox(p, p0):
+        sq = sum(
+            jnp.sum((a.astype(jnp.float32) - b.astype(jnp.float32)) ** 2)
+            for a, b in zip(
+                jax.tree_util.tree_leaves(p), jax.tree_util.tree_leaves(p0)
+            )
+        )
+        return 0.5 * mu * sq
+
+    return prox
+
+
 def _diffuse(tree: Any, weights: Any) -> Any:
     """Masked FedAvg + full-model diffusion: every node receives the
     aggregate (the FullModelCommand equivalent of the protocol path)."""
@@ -74,6 +93,15 @@ class VmapFederation:
         loss_fn: (logits, labels) -> per-sample losses.
         seed: init seed (all nodes share the initial model, like the
             reference's init-weights gossip).
+        algorithm: "fedavg" (default), "fedprox" (adds the proximal
+            pull ``mu/2·||w - w_round_start||²`` to every local loss —
+            same math as the protocol path's FedProxCallback), or
+            "scaffold" (control-variate-corrected local steps; carry
+            the state from :meth:`init_scaffold_state` through
+            ``round(..., scaffold_state=...)`` — same Option-II math
+            as the protocol path's ScaffoldCallback/Scaffold
+            aggregator, vectorized over the node axis).
+        prox_mu: FedProx proximal coefficient (algorithm="fedprox").
     """
 
     def __init__(
@@ -86,9 +114,16 @@ class VmapFederation:
         loss_fn: Callable = cross_entropy_loss,
         seed: int = 0,
         aux_mode: str = "mean",
+        algorithm: str = "fedavg",
+        prox_mu: float = 0.01,
     ) -> None:
         if aux_mode not in ("mean", "local"):
             raise ValueError(f"aux_mode must be 'mean' or 'local', got {aux_mode!r}")
+        if algorithm not in ("fedavg", "fedprox", "scaffold"):
+            raise ValueError(
+                f"algorithm must be 'fedavg', 'fedprox' or 'scaffold', "
+                f"got {algorithm!r}"
+            )
         self.module = module
         self.n_nodes = int(n_nodes)
         self.mesh = mesh
@@ -100,8 +135,11 @@ class VmapFederation:
         # them like parameters (one consistent global model); "local" =
         # keep each node's stats private (FedBN, Li et al. 2021).
         self.aux_mode = aux_mode
+        self.algorithm = algorithm
+        self.prox_mu = float(prox_mu)
         self._round_fn: Optional[Callable] = None
         self._round_aux_fn: Optional[Callable] = None
+        self._round_scaffold_fn: Optional[Callable] = None
         self._eval_fn: Optional[Callable] = None
         self._eval_aux_fn: Optional[Callable] = None
 
@@ -152,9 +190,11 @@ class VmapFederation:
         opt = self._opt
         loss_fn = self._loss_fn
         module = self.module
+        prox = _make_prox(self.algorithm, self.prox_mu)
 
         def local_train(params, xb, yb, epochs):
             """One node's local fit: epochs × scan over batches."""
+            p0 = params  # round-start weights (FedProx anchor)
             opt_state = opt.init(params)
 
             def batch_step(carry, batch):
@@ -163,7 +203,7 @@ class VmapFederation:
 
                 def loss_of(pp):
                     logits = module.apply({"params": pp}, x, train=False)
-                    return loss_fn(logits, y).mean()
+                    return loss_fn(logits, y).mean() + prox(pp, p0)
 
                 loss, grads = jax.value_and_grad(loss_of)(p)
                 updates, o = opt.update(grads, o, p)
@@ -214,8 +254,10 @@ class VmapFederation:
         loss_fn = self._loss_fn
         module = self.module
         aux_mode = self.aux_mode
+        prox = _make_prox(self.algorithm, self.prox_mu)
 
         def local_train(params, aux, xb, yb, epochs):
+            p0 = params  # round-start weights (FedProx anchor)
             opt_state = opt.init(params)
 
             def batch_step(carry, batch):
@@ -226,7 +268,7 @@ class VmapFederation:
                     logits, new_a = module.apply(
                         {"params": pp, **a}, x, train=True, mutable=list(a)
                     )
-                    return loss_fn(logits, y).mean(), new_a
+                    return loss_fn(logits, y).mean() + prox(pp, p0), new_a
 
                 (loss, new_a), grads = jax.value_and_grad(
                     loss_of, has_aux=True
@@ -288,6 +330,148 @@ class VmapFederation:
             out_shardings=(sharding, sharding, sharding),
         )
 
+    # --- SCAFFOLD (Karimireddy et al. 2019, Option II) ---
+
+    def init_scaffold_state(self, params: Any) -> tuple[Any, Any]:
+        """(c_locals [N, ...], c_global [...]) — zero control variates
+        (the protocol path's ScaffoldCallback.on_fit_start equivalent,
+        callbacks.py:90-96)."""
+        c_locals = jax.tree_util.tree_map(jnp.zeros_like, params)
+        c_global = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape[1:], p.dtype), params
+        )
+        return self._shard(c_locals), c_global
+
+    def _build_round_scaffold(self) -> Callable:
+        """Round program with control-variate-corrected local steps.
+
+        Per node (ScaffoldCallback math, callbacks.py:98-124): every
+        gradient is corrected by ``c - c_i``; after K local steps
+        ``c_i+ = c_i - c + (x - y_i)/(K·lr)``. Server (Scaffold
+        aggregator math, aggregators/scaffold.py): params aggregate by
+        the same masked FedAvg as every algorithm (equivalent to
+        ``x + mean(delta_y)`` since all nodes start from x), and
+        ``c += (|S|/N)·mean_S(delta_c)``. Unelected nodes' c_i do not
+        advance (they did not train)."""
+        opt = self._opt
+        loss_fn = self._loss_fn
+        module = self.module
+        aux_mode = self.aux_mode
+        lr = self.learning_rate
+        n_nodes = self.n_nodes
+
+        def local_train(params, c_i, c_g, aux, xb, yb, epochs):
+            p0 = params
+            # Fixed during the round (the callback computes it once).
+            corr = jax.tree_util.tree_map(
+                lambda c, ci: (c - ci).astype(c.dtype), c_g, c_i
+            )
+            opt_state = opt.init(params)
+
+            def batch_step(carry, batch):
+                p, o, a = carry
+                x, y = batch
+
+                def loss_of(pp):
+                    logits, new_a = module.apply(
+                        {"params": pp, **a}, x, train=True, mutable=list(a)
+                    )
+                    return loss_fn(logits, y).mean(), new_a
+
+                (loss, new_a), grads = jax.value_and_grad(
+                    loss_of, has_aux=True
+                )(p)
+                grads = jax.tree_util.tree_map(
+                    lambda g, c: g + c.astype(g.dtype), grads, corr
+                )
+                updates, o = opt.update(grads, o, p)
+                p = optax.apply_updates(p, updates)
+                return (p, o, new_a), loss
+
+            if epochs <= 0:  # aggregation-only round: nothing local
+                logits = module.apply(
+                    {"params": params, **aux}, xb[0], train=False
+                )
+                return params, c_i, aux, loss_fn(logits, yb[0]).mean()
+
+            def epoch_body(_, carry):
+                p, o, a, _last = carry
+                (p, o, a), losses = jax.lax.scan(batch_step, (p, o, a), (xb, yb))
+                return (p, o, a, jnp.mean(losses))
+
+            params, opt_state, aux, loss = jax.lax.fori_loop(
+                0, epochs, epoch_body,
+                (params, opt_state, aux, jnp.float32(0)),
+            )
+            # Option II: c_i+ = c_i - c + (x - y)/(K·lr)
+            k_steps = epochs * xb.shape[0]
+            scale = 1.0 / max(k_steps * lr, 1e-12)
+            new_c_i = jax.tree_util.tree_map(
+                lambda ci, cg, x0, y_: (
+                    ci.astype(jnp.float32)
+                    - cg.astype(jnp.float32)
+                    + scale * (x0.astype(jnp.float32) - y_.astype(jnp.float32))
+                ).astype(ci.dtype),
+                c_i, c_g, p0, params,
+            )
+            return params, new_c_i, aux, loss
+
+        def round_impl(params, c_locals, c_global, aux, xs, ys, weights,
+                       epochs=1):
+            trained, new_c, new_aux, losses = jax.vmap(
+                lambda p, ci, a, x, y: local_train(
+                    p, ci, c_global, a, x, y, epochs
+                )
+            )(params, c_locals, aux, xs, ys)
+            out_params = _diffuse(trained, weights)
+
+            sel = weights > 0
+
+            def keep_elected(new, old):
+                return jnp.where(
+                    sel.reshape((-1,) + (1,) * (new.ndim - 1)), new, old
+                )
+
+            out_c = jax.tree_util.tree_map(keep_elected, new_c, c_locals)
+            # c += (|S|/N) · mean over ELECTED of delta_c (uniform mean,
+            # per the paper — not the sample-weighted FedAvg weights).
+            mask = sel.astype(jnp.float32)
+            uniform_mean = _masked_leaf_mean(mask)
+            frac = jnp.sum(mask) / n_nodes
+            out_cg = jax.tree_util.tree_map(
+                lambda cg, dcm: (
+                    cg.astype(jnp.float32) + frac * dcm.astype(jnp.float32)
+                ).astype(cg.dtype),
+                c_global,
+                jax.tree_util.tree_map(
+                    lambda n, o: uniform_mean(
+                        n.astype(jnp.float32) - o.astype(jnp.float32)
+                    ),
+                    new_c, c_locals,
+                ),
+            )
+            if aux_mode == "local":
+                out_aux = jax.tree_util.tree_map(keep_elected, new_aux, aux)
+            else:
+                out_aux = _diffuse(new_aux, weights)
+            return out_params, out_c, out_cg, out_aux, losses
+
+        if self.mesh is None:
+            return jax.jit(
+                round_impl, static_argnums=(7,), donate_argnums=(0, 1, 2, 3)
+            )
+        sharding = federation_sharding(self.mesh)
+        repl = replicated(self.mesh)
+        return jax.jit(
+            round_impl,
+            static_argnums=(7,),
+            donate_argnums=(0, 1, 2, 3),
+            in_shardings=(
+                sharding, sharding, repl, sharding, sharding, sharding, repl
+            ),
+            out_shardings=(sharding, sharding, repl, sharding, sharding),
+        )
+
     def round(
         self,
         params: Any,
@@ -296,6 +480,7 @@ class VmapFederation:
         weights: Optional[Any] = None,
         epochs: int = 1,
         aux: Optional[Any] = None,
+        scaffold_state: Optional[tuple[Any, Any]] = None,
     ) -> tuple[Any, ...]:
         """Run one federated round. ``weights`` [N]: FedAvg weight per
         node (0 = not in the round's train set); default = uniform full
@@ -305,10 +490,31 @@ class VmapFederation:
         not None (mutable collections from :meth:`init_state` — possibly
         ``{}`` for aux-free modules, the API stays uniform) returns
         ``(params, aux, losses)`` — stats trained with ``train=True``
-        and aggregated per :attr:`aux_mode`."""
+        and aggregated per :attr:`aux_mode`.
+
+        algorithm="scaffold": pass ``scaffold_state`` from
+        :meth:`init_scaffold_state`; returns
+        ``(params, aux, scaffold_state, losses)`` (``aux`` is ``{}``
+        for aux-free modules)."""
         if weights is None:
             weights = jnp.ones((self.n_nodes,), jnp.float32)
         weights = jnp.asarray(weights, jnp.float32)
+        if self.algorithm == "scaffold":
+            if scaffold_state is None:
+                raise ValueError(
+                    "algorithm='scaffold' requires scaffold_state "
+                    "(init_scaffold_state(params))"
+                )
+            if self._round_scaffold_fn is None:
+                self._round_scaffold_fn = self._build_round_scaffold()
+            c_locals, c_global = scaffold_state
+            params, c_locals, c_global, aux_out, losses = (
+                self._round_scaffold_fn(
+                    params, c_locals, c_global,
+                    {} if aux is None else aux, xs, ys, weights, epochs,
+                )
+            )
+            return params, aux_out, (c_locals, c_global), losses
         if aux is not None:
             if self._round_aux_fn is None:
                 self._round_aux_fn = self._build_round_aux()

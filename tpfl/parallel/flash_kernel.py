@@ -196,7 +196,7 @@ def _flash(q, k, v, causal: bool, block: int, interpret: bool):
     return out
 
 
-def _flash_fwd_impl(q, k, v, causal, block, interpret):
+def _flash_fwd_impl(q, k, v, causal, block, interpret, out_dtype=None):
     b, s, h, d = q.shape
     blk = min(block, s)
     s_pad = -(-s // blk) * blk
@@ -210,7 +210,7 @@ def _flash_fwd_impl(q, k, v, causal, block, interpret):
             _fwd_kernel, block=blk, causal=causal, scale=1.0 / (d**0.5)
         ),
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, s_pad, d_pad), q.dtype),
+            jax.ShapeDtypeStruct((b * h, s_pad, d_pad), out_dtype or q.dtype),
             # lse rows are stored 8 lanes wide (col 0 meaningful): a
             # (1, blk) block of a 2-D array violates mosaic's (8, 128)
             # tiling rule on real TPUs.
@@ -241,14 +241,58 @@ def _flash_fwd(q, k, v, causal, block, interpret):
     return out, (q, k, v, out, lse)
 
 
+def _flash_bwd_kernels(qp, kp, vp, dop, lse, dd, causal, blk, d_pad,
+                       interpret, dtypes):
+    """The two flash backward pallas calls over PREPPED operands
+    ([BH, S_pad, D_pad]; lse/dd 8-lane wide [BH, S_pad, 8] f32).
+    Shared by the standalone VJP and the ring backward (which supplies
+    a GLOBAL lse/delta covering all ring steps)."""
+    bh, s_pad, _ = qp.shape
+    nblk = s_pad // blk
+    d = dtypes["d"]
+    scale = 1.0 / (d**0.5)
+
+    qkv_spec = pl.BlockSpec((1, blk, d_pad), lambda bhi, i, j: (bhi, i, 0))
+    kv_of_j = pl.BlockSpec((1, blk, d_pad), lambda bhi, i, j: (bhi, j, 0))
+    row_of_i = pl.BlockSpec((1, blk, 8), lambda bhi, i, j: (bhi, i, 0))
+    row_of_j = pl.BlockSpec((1, blk, 8), lambda bhi, i, j: (bhi, j, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, block=blk, causal=causal, scale=scale),
+        out_shape=jax.ShapeDtypeStruct((bh, s_pad, d_pad), dtypes["q"]),
+        grid=(bh, nblk, nblk),  # (BH, query block, key sweep)
+        in_specs=[qkv_spec, kv_of_j, kv_of_j, qkv_spec, row_of_i, row_of_i],
+        out_specs=qkv_spec,
+        scratch_shapes=[pltpu.VMEM((blk, d_pad), jnp.float32)],
+        interpret=interpret,
+    )(qp, kp, vp, dop, lse, dd)
+
+    q_of_j = pl.BlockSpec((1, blk, d_pad), lambda bhi, i, j: (bhi, j, 0))
+    kv_of_i = pl.BlockSpec((1, blk, d_pad), lambda bhi, i, j: (bhi, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, block=blk, causal=causal, scale=scale),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s_pad, d_pad), dtypes["k"]),
+            jax.ShapeDtypeStruct((bh, s_pad, d_pad), dtypes["v"]),
+        ],
+        grid=(bh, nblk, nblk),  # (BH, key block, query sweep)
+        in_specs=[q_of_j, kv_of_i, kv_of_i, q_of_j, row_of_j, row_of_j],
+        out_specs=[kv_of_i, kv_of_i],
+        scratch_shapes=[
+            pltpu.VMEM((blk, d_pad), jnp.float32),
+            pltpu.VMEM((blk, d_pad), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp, dop, lse, dd)
+    return dq, dk, dv
+
+
 def _flash_bwd(causal, block, interpret, res, dout):
     q, k, v, out, lse = res
     b, s, h, d = q.shape
     blk = min(block, s)
     s_pad = -(-s // blk) * blk
     d_pad = -(-d // 128) * 128
-    nblk = s_pad // blk
-    scale = 1.0 / (d**0.5)
 
     qp = _prep(q, b, h, s, d, s_pad, d_pad)
     kp = _prep(k, b, h, s, d, s_pad, d_pad)
@@ -262,39 +306,10 @@ def _flash_bwd(causal, block, interpret, res, dout):
     # lse pad rows: 0 is safe — their dO rows are zero, so every term
     # they touch (p * 0, ds * 0) vanishes before it reaches real rows.
 
-    qkv_spec = pl.BlockSpec((1, blk, d_pad), lambda bhi, i, j: (bhi, i, 0))
-    kv_of_j = pl.BlockSpec((1, blk, d_pad), lambda bhi, i, j: (bhi, j, 0))
-    row_of_i = pl.BlockSpec((1, blk, 8), lambda bhi, i, j: (bhi, i, 0))
-    row_of_j = pl.BlockSpec((1, blk, 8), lambda bhi, i, j: (bhi, j, 0))
-
-    dq = pl.pallas_call(
-        functools.partial(_dq_kernel, block=blk, causal=causal, scale=scale),
-        out_shape=jax.ShapeDtypeStruct((b * h, s_pad, d_pad), q.dtype),
-        grid=(b * h, nblk, nblk),  # (BH, query block, key sweep)
-        in_specs=[qkv_spec, kv_of_j, kv_of_j, qkv_spec, row_of_i, row_of_i],
-        out_specs=qkv_spec,
-        scratch_shapes=[pltpu.VMEM((blk, d_pad), jnp.float32)],
-        interpret=interpret,
-    )(qp, kp, vp, dop, lse, dd)
-
-    q_of_j = pl.BlockSpec((1, blk, d_pad), lambda bhi, i, j: (bhi, j, 0))
-    kv_of_i = pl.BlockSpec((1, blk, d_pad), lambda bhi, i, j: (bhi, i, 0))
-    dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, block=blk, causal=causal, scale=scale),
-        out_shape=[
-            jax.ShapeDtypeStruct((b * h, s_pad, d_pad), k.dtype),
-            jax.ShapeDtypeStruct((b * h, s_pad, d_pad), v.dtype),
-        ],
-        grid=(b * h, nblk, nblk),  # (BH, key block, query sweep)
-        in_specs=[q_of_j, kv_of_i, kv_of_i, q_of_j, row_of_j, row_of_j],
-        out_specs=[kv_of_i, kv_of_i],
-        scratch_shapes=[
-            pltpu.VMEM((blk, d_pad), jnp.float32),
-            pltpu.VMEM((blk, d_pad), jnp.float32),
-        ],
-        interpret=interpret,
-    )(qp, kp, vp, dop, lse, dd)
-
+    dq, dk, dv = _flash_bwd_kernels(
+        qp, kp, vp, dop, lse, dd, causal, blk, d_pad, interpret,
+        {"q": q.dtype, "k": k.dtype, "v": v.dtype, "d": d},
+    )
     return (
         _unprep(dq, b, h, s, d),
         _unprep(dk, b, h, s, d),
@@ -303,6 +318,94 @@ def _flash_bwd(causal, block, interpret, res, dout):
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def ring_block_size(s: int, block: int) -> int:
+    """Largest kernel block ≤ ``block`` that tiles ``s`` exactly — ring
+    steps need s_pad == s (an off-diagonal ring step is FULL attention;
+    unmasked pad keys would corrupt it). Multiples of 8 keep mosaic's
+    (8, 128) tiling rule; if none divides, a single s-sized block
+    (block dims equal to array dims) is always legal."""
+    if s <= block:
+        return s
+    blk = (min(block, s) // 8) * 8
+    while blk >= 8 and s % blk:
+        blk -= 8
+    return blk if blk >= 8 and s % blk == 0 else s
+
+
+def _rows_to_lanes(x: jnp.ndarray) -> jnp.ndarray:
+    """[B, H, S] f32 per-row scalars -> the kernels' 8-lane-wide
+    [BH, S, 8] layout (mosaic tiling rule, see _fwd_kernel)."""
+    b, h, s = x.shape
+    x = x.reshape(b * h, s).astype(jnp.float32)
+    return jnp.broadcast_to(x[..., None], (b * h, s, 8))
+
+
+def flash_block_fwd(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool,
+    block: int = 1024,
+    interpret: bool | None = None,
+):
+    """One flash forward over a (q-block, kv-block) pair, returning
+    ``(out, lse)`` with lse as [B, H, S] f32 — the building block of
+    ring attention's per-step inner (the ring merges steps by
+    logsumexp, so it needs the softmax residual, not just the output).
+    Not differentiable on its own: the ring defines its own VJP."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, s, h, d = q.shape
+    blk = ring_block_size(s, block)
+    # f32 out: the ring merges steps at f32 — a per-step downcast to
+    # q.dtype would round every block before the logsumexp rescale.
+    out, lse8 = _flash_fwd_impl(
+        q, k, v, causal, blk, interpret, out_dtype=jnp.float32
+    )
+    lse = lse8[:, :s, 0].reshape(b, h, s)
+    return out, lse
+
+
+def flash_block_bwd(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    do: jnp.ndarray,
+    lse: jnp.ndarray,
+    delta: jnp.ndarray,
+    causal: bool,
+    block: int = 1024,
+    interpret: bool | None = None,
+):
+    """Flash backward for one (q-block, kv-block) pair with EXTERNAL
+    softmax residuals: ``lse``/``delta`` are [B, H, S] f32 computed
+    over the FULL attention row (all ring steps), so per-step
+    contributions recomputed here sum exactly to the global gradient.
+    Returns (dq, dk, dv) in the operands' dtypes."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, s, h, d = q.shape
+    blk = ring_block_size(s, block)
+    d_pad = -(-d // 128) * 128
+    qp = _prep(q, b, h, s, d, s, d_pad)
+    kp = _prep(k, b, h, s, d, s, d_pad)
+    vp = _prep(v, b, h, s, d, s, d_pad)
+    dop = _prep(do, b, h, s, d, s, d_pad)
+    # f32 grads out: per-step contributions sum in the ring's f32
+    # accumulators; rounding each to the operand dtype first would
+    # compound across steps.
+    dq, dk, dv = _flash_bwd_kernels(
+        qp, kp, vp, dop, _rows_to_lanes(lse), _rows_to_lanes(delta),
+        causal, blk, d_pad, interpret,
+        {"q": jnp.float32, "k": jnp.float32, "v": jnp.float32, "d": d},
+    )
+    return (
+        _unprep(dq, b, h, s, d),
+        _unprep(dk, b, h, s, d),
+        _unprep(dv, b, h, s, d),
+    )
 
 
 def flash_attention(

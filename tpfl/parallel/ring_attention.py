@@ -10,8 +10,11 @@ log-sum-exp-carrying accumulation of Liu et al. 2023 "Ring Attention
 with Blockwise Transformers" / Milakov & Gimelshein 2018). No device
 ever materializes the full [S, S] score matrix or the full K/V.
 
-Memory per device: O(S/n · d) activations + O((S/n)²) scores — a 128k
-sequence on 8 devices attends with 16k-sized blocks.
+Memory per device: O(S/n · d) activations; the default flash inner
+(``impl="flash"``) keeps score tiles in VMEM (Pallas kernel per ring
+step, ring-level recompute VJP — see the flash-ring notes below), the
+``impl="xla"`` fallback materializes O((S/n)²) scores per step. A 128k
+sequence on 8 devices attends with 16k-sized local blocks either way.
 """
 
 from __future__ import annotations
@@ -247,17 +250,18 @@ def _blockwise_vjp_bwd(causal, block, s_len, res, g):
 _blockwise.defvjp(_blockwise_vjp_fwd, _blockwise_vjp_bwd)
 
 
-def ring_attention(
+def _ring_xla(
     q: jnp.ndarray,
     k: jnp.ndarray,
     v: jnp.ndarray,
     axis_name: str,
     causal: bool = False,
 ) -> jnp.ndarray:
-    """Sequence-parallel attention INSIDE shard_map: q/k/v are the
-    LOCAL sequence blocks [B, S/n, H, D] of a sequence sharded over
-    ``axis_name``; K/V rotate the ring via ppermute. Returns the local
-    output block."""
+    """Pure-XLA ring inner (einsum over full local blocks): the
+    reference implementation the flash ring is tested against, and the
+    fallback when the Pallas path is unavailable. Differentiated by
+    reverse-mode through the scan (stores per-step score residuals —
+    fine at test scale, the flash ring's recompute VJP avoids it)."""
     n = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
     b, lq, h, d = q.shape
@@ -300,8 +304,188 @@ def ring_attention(
     return jnp.moveaxis(out, 1, 2).astype(q.dtype)  # [B, Lq, H, D]
 
 
+# --- flash ring: Pallas flash kernel per ring step, recompute VJP ---
+#
+# Forward: each ring step attends the local Q block to the rotating
+# K/V block with the Pallas flash kernel (flash_kernel.flash_block_fwd
+# — MXU score matmuls, VMEM-resident online softmax, the block=1024
+# win), and steps are merged by logsumexp:
+#   lse' = logaddexp(lse, lse_t);  o' = o·e^{lse-lse'} + o_t·e^{lse_t-lse'}
+# which is exactly the online-softmax accumulation at block
+# granularity. Only (out, lse) carry across steps — no O(lq²) score
+# memory at the XLA level.
+#
+# Backward (jax.custom_vjp): banks just (q, k, v, out, lse); recomputes
+# per-step gradients with the flash backward kernels fed the GLOBAL
+# lse/delta (flash_kernel.flash_block_bwd), re-rotating K/V around the
+# ring. dK/dV contributions accumulate in buffers that rotate WITH
+# their K/V block, so after the full circle each block's gradient
+# arrives back at its owner — the Liu et al. ring backward, with the
+# inner math on the MXU. Residual memory is O(local block), where
+# reverse-mode through the forward scan would stash O(n·block²).
+
+
+def _ring_merge(o, lse, o_t, lse_t):
+    """Fold one ring step's (o_t, lse_t) into the running (o, lse).
+    o/o_t: [B, Lq, H, D] (o f32); lse/lse_t: [B, H, Lq] f32."""
+    new = jnp.logaddexp(lse, lse_t)
+    a = jnp.moveaxis(jnp.exp(lse - new), 1, 2)[..., None]
+    b_ = jnp.moveaxis(jnp.exp(lse_t - new), 1, 2)[..., None]
+    return o * a + o_t.astype(jnp.float32) * b_, new
+
+
+def _ring_flash_fwd_core(q, k, v, axis_name, causal, block, interpret):
+    from tpfl.parallel.flash_kernel import flash_block_fwd
+
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, lq, h, d = q.shape
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def attend(c, kt, vt, diag):
+        o_t, lse_t = flash_block_fwd(
+            q, kt, vt, causal=diag, block=block, interpret=interpret
+        )
+        return _ring_merge(*c, o_t, lse_t)
+
+    def body(t, carry):
+        o, lse, kt, vt = carry
+        src = (my - t) % n
+        if causal:
+            # Diagonal step: causal within the block. Earlier blocks:
+            # full attention. Future blocks: skipped at runtime.
+            o, lse = jax.lax.cond(
+                src == my,
+                lambda c: attend(c, kt, vt, True),
+                lambda c: jax.lax.cond(
+                    src < my,
+                    lambda cc: attend(cc, kt, vt, False),
+                    lambda cc: cc,
+                    c,
+                ),
+                (o, lse),
+            )
+        else:
+            o, lse = attend((o, lse), kt, vt, False)
+        kt = jax.lax.ppermute(kt, axis_name, perm)
+        vt = jax.lax.ppermute(vt, axis_name, perm)
+        return o, lse, kt, vt
+
+    o = jnp.zeros((b, lq, h, d), jnp.float32)
+    lse = jnp.full((b, h, lq), -jnp.inf, jnp.float32)
+    o, lse, _, _ = jax.lax.fori_loop(0, n, body, (o, lse, k, v))
+    return o.astype(q.dtype), lse
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_flash(q, k, v, axis_name: str, causal: bool, block: int,
+                interpret: bool):
+    out, _ = _ring_flash_fwd_core(q, k, v, axis_name, causal, block, interpret)
+    return out
+
+
+def _ring_flash_vjp_fwd(q, k, v, axis_name, causal, block, interpret):
+    out, lse = _ring_flash_fwd_core(
+        q, k, v, axis_name, causal, block, interpret
+    )
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_vjp_bwd(axis_name, causal, block, interpret, res, g):
+    from tpfl.parallel.flash_kernel import flash_block_bwd
+
+    q, k, v, out, lse = res
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    g32 = g.astype(jnp.float32)
+    delta = jnp.einsum(
+        "bshd,bshd->bhs", g32, out.astype(jnp.float32)
+    )  # [B, H, Lq]
+
+    def contrib(kt, vt, diag):
+        return flash_block_bwd(
+            q, kt, vt, g, lse, delta, causal=diag, block=block,
+            interpret=interpret,
+        )
+
+    def add(c, kt, vt, diag):
+        dq, dkt, dvt = c
+        dq_c, dk_c, dv_c = contrib(kt, vt, diag)
+        return (
+            dq + dq_c.astype(jnp.float32),
+            dkt + dk_c.astype(jnp.float32),
+            dvt + dv_c.astype(jnp.float32),
+        )
+
+    def body(t, carry):
+        dq, kt, vt, dkt, dvt = carry
+        src = (my - t) % n
+        if causal:
+            dq, dkt, dvt = jax.lax.cond(
+                src == my,
+                lambda c: add(c, kt, vt, True),
+                lambda c: jax.lax.cond(
+                    src < my,
+                    lambda cc: add(cc, kt, vt, False),
+                    lambda cc: cc,
+                    c,
+                ),
+                (dq, dkt, dvt),
+            )
+        else:
+            dq, dkt, dvt = add((dq, dkt, dvt), kt, vt, False)
+        # dK/dV accumulators rotate WITH their block: after the full
+        # circle each block's gradient is back at its owner.
+        kt = jax.lax.ppermute(kt, axis_name, perm)
+        vt = jax.lax.ppermute(vt, axis_name, perm)
+        dkt = jax.lax.ppermute(dkt, axis_name, perm)
+        dvt = jax.lax.ppermute(dvt, axis_name, perm)
+        return dq, kt, vt, dkt, dvt
+
+    dq = jnp.zeros(q.shape, jnp.float32)
+    dkv = jnp.zeros(k.shape, jnp.float32)
+    dq, _, _, dk, dv = jax.lax.fori_loop(
+        0, n, body, (dq, k, v, dkv, dkv)
+    )
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    causal: bool = False,
+    impl: str = "flash",
+    block: int = 1024,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Sequence-parallel attention INSIDE shard_map: q/k/v are the
+    LOCAL sequence blocks [B, S/n, H, D] of a sequence sharded over
+    ``axis_name``; K/V rotate the ring via ppermute. Returns the local
+    output block.
+
+    ``impl="flash"`` (default) runs the Pallas flash kernel per ring
+    step with a ring-level recompute VJP (see module notes above);
+    ``impl="xla"`` keeps the plain einsum inner (reference/fallback
+    path, identical math)."""
+    if impl == "xla":
+        return _ring_xla(q, k, v, axis_name, causal)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _ring_flash(q, k, v, axis_name, causal, block, bool(interpret))
+
+
 def make_ring_attention(
-    mesh: Mesh, axis_name: str = "sp", causal: bool = False
+    mesh: Mesh,
+    axis_name: str = "sp",
+    causal: bool = False,
+    impl: str = "flash",
+    block: int = 1024,
 ):
     """shard_map-wrapped ring attention: takes GLOBAL [B, S, H, D]
     arrays sharded (or shardable) over ``axis_name`` on the sequence
@@ -311,7 +495,13 @@ def make_ring_attention(
     spec = PartitionSpec(None, axis_name, None, None)
 
     fn = shard_map(
-        partial(ring_attention, axis_name=axis_name, causal=causal),
+        partial(
+            ring_attention,
+            axis_name=axis_name,
+            causal=causal,
+            impl=impl,
+            block=block,
+        ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

@@ -38,19 +38,21 @@ class Settings:
     starts). The per-round set still rotates pseudo-randomly with the
     round number. Recommended for 100+ node federations.
 
-    Adversarial trade-off: hash ranks are grindable — a participant
-    chooses its own address, so an adversary can precompute an addr
-    that ranks top-K for essentially every round of a known experiment
-    name and guarantee itself permanent train-set membership. The vote
-    protocol (each elector samples with private randomness) does not
-    have this property, which is why "vote" stays the global default
-    and the recommended mode for byzantine settings (pair hash election
-    with a robust aggregator — tpfl.learning.aggregators.robust — if
-    you need both scale and poisoning tolerance). A per-experiment
-    random beacon (e.g. a hash of the init-model bytes) would blunt
-    pre-join grinding but breaks rank agreement for late joiners that
-    adopt a mid-experiment FullModel instead of the init weights, so it
-    is deliberately not mixed in. See docs/protocol.md."""
+    Adversarial model: the rank mixes in a per-experiment random
+    beacon (hash of the initiator's init-model bytes, carried by the
+    StartLearning broadcast — stages.base_node.election_rank), so an
+    address committed BEFORE the experiment starts cannot be ground to
+    rank top-K: the beacon is unknown at address-choice time, and a
+    fixed address's election frequency under random beacons is uniform
+    (tested). What remains is a pre-commitment assumption: an
+    adversary that observes the beacon and only THEN joins with a
+    freshly ground address still wins, and the initiator itself could
+    grind init weights to favor an address it controls. Deployments
+    that cannot pre-commit membership (or trust the initiator) should
+    keep "vote" (each elector samples with private randomness) — the
+    global default — and pair hash election with a robust aggregator
+    (tpfl.learning.aggregators.robust) when they need both scale and
+    poisoning tolerance. See docs/protocol.md."""
 
     INIT_GOSSIP_STATIC_EXIT_S: float = 30.0
     """Wall-clock quiet window before the init-weights diffusion stops
@@ -137,6 +139,13 @@ class Settings:
     # --- observability ---
     RESOURCE_MONITOR_PERIOD: float = 1.0
 
+    GOSSIP_METRICS: bool = True
+    """Broadcast eval metrics to the federation after each round
+    (reference MetricsCommand behavior). At N nodes each broadcast
+    TTL-floods through every node — O(N²) handler work per round for
+    observability only — so the scale profile turns it off (metrics
+    still log locally; the experiment result does not depend on it)."""
+
     # --- determinism / TPU ---
     SEED: int | None = None
     """Global seed for reproducible experiments (fork feature)."""
@@ -217,6 +226,7 @@ class Settings:
         cls.WAIT_HEARTBEATS_CONVERGENCE = 0.5
         cls.ASYNC_LOGGER = False
         cls.FILE_LOGGER = False
+        cls.GOSSIP_METRICS = False
 
     @classmethod
     def snapshot(cls) -> dict[str, Any]:

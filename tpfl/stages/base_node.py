@@ -35,6 +35,23 @@ if TYPE_CHECKING:
     from tpfl.node import Node
 
 
+def election_rank(exp_name, beacon: str, round, addr: str) -> str:
+    """Hash-election sort key (Settings.ELECTION == "hash"): rank by
+    H(exp | beacon | round | addr), lowest first. The beacon is the
+    per-experiment shared random value from the StartLearning
+    broadcast (hash of the initiator's init-model bytes): without it a
+    participant could grind an address that ranks top-K for every
+    round of a predictable exp_name; with it, grinding requires
+    choosing the address AFTER the experiment — and its beacon —
+    exist (see settings.py ELECTION docs for the remaining
+    pre-commitment assumption)."""
+    import hashlib
+
+    return hashlib.sha256(
+        f"{exp_name}|{beacon}|{round}|{addr}".encode()
+    ).hexdigest()
+
+
 class StartLearningStage(Stage):
     """Reference start_learning_stage.py:35-112."""
 
@@ -131,18 +148,17 @@ class VoteTrainSetStage(Stage):
 
         if Settings.ELECTION == "hash":
             # Deterministic sortition (Settings.ELECTION docs): rank by
-            # H(exp|round|addr), top-K — no messages, no vote wait;
-            # agreement follows from membership-view agreement. The
-            # aggregator still tolerates view divergence exactly as it
-            # tolerates missing votes under the vote protocol.
-            import hashlib
-
-            def rank(addr: str) -> str:
-                return hashlib.sha256(
-                    f"{st.exp_name}|{st.round}|{addr}".encode()
-                ).hexdigest()
-
-            ranked = sorted(set(candidates), key=rank)
+            # H(exp|beacon|round|addr), top-K — no messages, no vote
+            # wait; agreement follows from membership-view agreement
+            # (the beacon rides the StartLearning broadcast, so every
+            # participant has it). The aggregator still tolerates view
+            # divergence exactly as it tolerates missing votes under
+            # the vote protocol.
+            beacon = getattr(node, "beacon", "")
+            ranked = sorted(
+                set(candidates),
+                key=lambda a: election_rank(st.exp_name, beacon, st.round, a),
+            )
             st.train_set = ranked[: Settings.TRAIN_SET_SIZE]
             logger.info(node.addr, f"Train set (hash): {st.train_set}")
             if check_early_stop(node):
@@ -230,7 +246,12 @@ def _await_round_result(
             return "full_model"
         if done_fn is not None and done_fn():
             return "done"
-        st.aggregated_model_event.wait(timeout=0.1)
+        # The event wakes this immediately on FullModel arrival; the
+        # timeout only bounds early-stop/done_fn detection latency.
+        # 0.5s (not 0.1s): at 1000 in-process nodes, ~990 waiters
+        # polling 10x/s were a ~10k-wakeups/s GIL tax on the very
+        # trainers forming the aggregate they wait for.
+        st.aggregated_model_event.wait(timeout=0.5)
         st.aggregated_model_event.clear()
     return "timeout"
 
@@ -305,17 +326,41 @@ class TrainStage(Stage):
                 if n != node.addr and not set(agg.get(n, [])) >= full
             ]
 
+        # Partial-aggregate encodes are cached per (aggregator state,
+        # except-set): between aggregator changes the payload bytes are
+        # identical, and re-running the jitted partial aggregation +
+        # device->host transfer + msgpack encode on EVERY push tick was
+        # the measured formation bottleneck at 1000 single-core nodes
+        # (the 10 trainers' exchange serialized behind per-tick encodes
+        # while 990 peers shared the GIL — docs/deployment.md).
+        encode_cache: dict = {}
+
         def model_for(nei: str) -> Optional[object]:
-            known = st.get_models_aggregated().get(nei, [])
-            model = node.aggregator.get_model(except_nodes=list(known))
-            if model is None:
+            known = tuple(sorted(st.get_models_aggregated().get(nei, [])))
+            key = (node.aggregator.version, known)
+            hit = encode_cache.get(key)
+            if hit is None:
+                model = node.aggregator.get_model(except_nodes=list(known))
+                if model is None:
+                    hit = (None, None, 0)
+                else:
+                    hit = (
+                        model.encode_parameters(),
+                        model.get_contributors(),
+                        model.get_num_samples(),
+                    )
+                if len(encode_cache) > 64:  # one round's worth, bounded
+                    encode_cache.clear()
+                encode_cache[key] = hit
+            payload, contributors, num_samples = hit
+            if payload is None:
                 return None
             return node.communication.build_weights(
                 PartialModelCommand.name,
                 st.round,
-                model.encode_parameters(),
-                contributors=model.get_contributors(),
-                num_samples=model.get_num_samples(),
+                payload,
+                contributors=contributors,
+                num_samples=num_samples,
             )
 
         node.communication.gossip_weights(
@@ -353,27 +398,23 @@ class TrainStage(Stage):
                     timeout=max(0.0, deadline - time.time())
                 )
             except NoModelsToAggregateError:
-                # Deliberate empty-round case: no result to diffuse —
-                # finish the round instead of gossiping our local fit
-                # as if it were the aggregate. Still announce readiness:
-                # non-train-set peers in WaitAggregatedModelsStage would
-                # otherwise burn the whole AGGREGATION_TIMEOUT waiting
-                # for a model that is never coming.
+                # Deliberate empty-round case: no result to diffuse.
+                # Same honesty rule as the wait-stage timeout: do NOT
+                # broadcast ModelsReady — we hold only round-start
+                # weights, and the announcement would mark us finished
+                # in every peer's nei_status, removing us as a
+                # FullModel push/relay target while a real aggregate
+                # may still exist elsewhere. (ModelsReady releases no
+                # waiter anyway: _await_round_result returns only on
+                # full-model arrival, done_fn, or timeout.) Routing
+                # through GossipModelStage keeps us receptive during
+                # the diffusion window; with no aggregate held it is a
+                # pass-through (holds_aggregate() is False).
                 logger.error(node.addr, "Nothing aggregated this round")
-                node.communication.broadcast(
-                    node.communication.build_msg(
-                        ModelsReadyCommand.name, [], round=st.round
-                    )
-                )
-                return RoundFinishedStage
+                return GossipModelStage
             except Exception as e:  # byzantine/malformed peer payloads
                 logger.error(node.addr, f"Aggregation failed: {e}")
-                node.communication.broadcast(
-                    node.communication.build_msg(
-                        ModelsReadyCommand.name, [], round=st.round
-                    )
-                )
-                return RoundFinishedStage
+                return GossipModelStage
             # A timed-out partial aggregate must not shadow the round's
             # authoritative full model if one arrived while the (possibly
             # slow, jit-compiling) aggregation math ran.
@@ -398,7 +439,7 @@ class TrainStage(Stage):
     def _evaluate(node: "Node") -> None:
         """Eval + metric gossip (reference train_stage.py:102-117)."""
         metrics = node.learner.evaluate()
-        if not metrics:
+        if not metrics or not Settings.GOSSIP_METRICS:
             return
         flat: list[str] = []
         for k, v in metrics.items():
@@ -477,18 +518,38 @@ class GossipModelStage(Stage):
                 if st.nei_status.get(n, -1) < st.round
             ]
 
+        # One encode per MODEL VERSION: per-push re-encodes
+        # (device->host + msgpack each) would burn the GIL the
+        # diffusion wave needs — same caching rule as TrainStage's
+        # partial pushes and StartLearningStage's init payload. Keyed
+        # on state.model_version, NOT once per stage entry: a node that
+        # entered holding its timed-out PARTIAL aggregate can receive
+        # the round's authoritative FullModel mid-push, and the stale
+        # cached bytes must not keep flowing (peers accept same-round
+        # FullModels unconditionally, and the relay forwards verbatim).
+        fullmodel_cache: dict = {}
+
         def model_for(nei: str) -> Optional[object]:
-            model = node.learner.get_model()
-            try:
-                contributors = model.get_contributors()
-            except ValueError:
-                contributors = [node.addr]
+            version = st.model_version
+            if fullmodel_cache.get("version") != version:
+                model = node.learner.get_model()
+                try:
+                    contributors = model.get_contributors()
+                except ValueError:
+                    contributors = [node.addr]
+                fullmodel_cache["payload"] = (
+                    model.encode_parameters(),
+                    contributors,
+                    model.get_num_samples(),
+                )
+                fullmodel_cache["version"] = version
+            payload, contributors, num_samples = fullmodel_cache["payload"]
             return node.communication.build_weights(
                 FullModelCommand.name,
                 st.round if st.round is not None else 0,
-                model.encode_parameters(),
+                payload,
                 contributors=contributors,
-                num_samples=model.get_num_samples(),
+                num_samples=num_samples,
             )
 
         node.communication.gossip_weights(
