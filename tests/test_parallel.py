@@ -494,8 +494,9 @@ def _dense_attention(q, k, v, causal):
     return jnp.moveaxis(jnp.einsum("bhqk,bkhd->bhqd", p, v), 1, 2)
 
 
+@pytest.mark.parametrize("impl", ["flash", "xla"])
 @pytest.mark.parametrize("causal", [False, True])
-def test_ring_attention_matches_dense(causal):
+def test_ring_attention_matches_dense(causal, impl):
     from tpfl.parallel.ring_attention import (
         blockwise_attention,
         make_ring_attention,
@@ -511,7 +512,9 @@ def test_ring_attention_matches_dense(causal):
     got_block = blockwise_attention(q, k, v, causal=causal, block_size=16)
     np.testing.assert_allclose(np.asarray(got_block), np.asarray(want), atol=2e-5)
     mesh = create_mesh({"sp": 8})
-    ring = make_ring_attention(mesh, causal=causal)
+    # impl pinned: the default is "auto" (xla off-TPU), so flash-ring
+    # exactness on the CPU suite must ask for the kernel explicitly.
+    ring = make_ring_attention(mesh, causal=causal, impl=impl)
     got_ring = ring(q, k, v)
     np.testing.assert_allclose(np.asarray(got_ring), np.asarray(want), atol=2e-5)
 
@@ -535,7 +538,7 @@ def test_ring_attention_grads_flow():
     from functools import partial
 
     fn = shard_map(
-        partial(ring_attention, axis_name="sp", causal=True),
+        partial(ring_attention, axis_name="sp", causal=True, impl="flash"),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     )
@@ -699,7 +702,7 @@ def test_transformer_lm_with_ring_attention_seam():
     base = model.module.apply({"params": model.get_parameters()}, tokens)
 
     mesh = create_mesh({"sp": 8})
-    ring = make_ring_attention(mesh, causal=True)
+    ring = make_ring_attention(mesh, causal=True, impl="flash")
     # The closure plugs in directly: it validates the causal kwarg the
     # block passes, so a causality mismatch raises instead of silently
     # attending the wrong way.
@@ -740,7 +743,8 @@ def test_transformer_lm_trains_with_ring_attention():
     mesh = create_mesh({"sp": 8})
     ring_mod = TransformerLM(
         vocab=32, dim=32, heads=2, n_layers=1,
-        compute_dtype=jnp.float32, attention_fn=make_ring_attention(mesh, causal=True),
+        compute_dtype=jnp.float32,
+        attention_fn=make_ring_attention(mesh, causal=True, impl="flash"),
     )
     base_mod = TransformerLM(
         vocab=32, dim=32, heads=2, n_layers=1, compute_dtype=jnp.float32
@@ -799,7 +803,9 @@ def test_composed_dp_sp_mesh_train_step():
     mod = TransformerLM(
         vocab=32, dim=32, heads=2, n_layers=1,
         compute_dtype=jnp.float32,
-        attention_fn=make_ring_attention(mesh, axis_name="sp", causal=True),
+        attention_fn=make_ring_attention(
+            mesh, axis_name="sp", causal=True, impl="flash"
+        ),
     )
     rng = np.random.default_rng(2)
     tokens = jnp.asarray(rng.integers(0, 31, (4, 32)), jnp.int32)

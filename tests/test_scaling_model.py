@@ -166,7 +166,7 @@ def test_ring_attention_permute_bytes_are_local_block_sized():
     for d in (2, 4, 8):
         mesh = create_mesh({"sp": d}, devices=jax.devices()[:d])
         ring = jax.shard_map(
-            partial(ring_attention, axis_name="sp", causal=True),
+            partial(ring_attention, axis_name="sp", causal=True, impl="flash"),
             mesh=mesh, in_specs=(spec,) * 3, out_specs=spec,
             check_vma=False,
         )

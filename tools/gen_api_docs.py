@@ -1,0 +1,220 @@
+"""Generate the markdown API reference under ``docs/api/`` from the
+``tpfl`` package's docstrings.
+
+The reference ships a sphinx tree with one auto-generated page per
+module (``/root/reference/docs/source/modules/*.rst`` + a docs.yml
+workflow); this repo's build image has no sphinx, so the same surface
+is produced by direct introspection: one ``docs/api/<module>.md`` per
+public module — module docstring, public classes (constructor + public
+methods with signatures and docstring summaries), public functions —
+plus an ``index.md`` grouped by subpackage.
+
+Output is deterministic (sorted walks, no timestamps) so CI can assert
+freshness::
+
+    python tools/gen_api_docs.py && git diff --exit-code docs/api
+
+Run with ``JAX_PLATFORMS=cpu`` to avoid grabbing the TPU just to read
+docstrings.
+"""
+
+from __future__ import annotations
+
+import importlib
+import inspect
+import pkgutil
+import re
+import sys
+from pathlib import Path
+from types import ModuleType
+
+REPO = Path(__file__).resolve().parent.parent
+OUT = REPO / "docs" / "api"
+
+# Examples are documented by their own source + docs/README; pb2-style
+# generated modules don't exist here.
+SKIP_PREFIXES = ("tpfl.examples",)
+
+
+def _iter_modules() -> list[str]:
+    import tpfl
+
+    names = ["tpfl"]
+    for info in pkgutil.walk_packages(tpfl.__path__, prefix="tpfl."):
+        if info.name.startswith(SKIP_PREFIXES):
+            continue
+        names.append(info.name)
+    return sorted(names)
+
+
+def _signature(obj) -> str:
+    try:
+        sig = str(inspect.signature(obj))
+    except (ValueError, TypeError):
+        return "(...)"
+    # Default-value reprs like "<function f at 0x7f...>" embed memory
+    # addresses — nondeterministic across runs, which would break the
+    # CI freshness check (git diff --exit-code docs/api).
+    return re.sub(r" at 0x[0-9a-fA-F]+", "", sig)
+
+
+def _summary(obj) -> str:
+    """First paragraph of the docstring, collapsed to one line."""
+    doc = inspect.getdoc(obj)
+    if not doc:
+        return ""
+    para = doc.split("\n\n", 1)[0]
+    return " ".join(para.split())
+
+
+def _full_doc(obj) -> str:
+    # flax modules auto-append a constructor signature to the class
+    # docstring; its default-value reprs carry memory addresses too.
+    return re.sub(r" at 0x[0-9a-fA-F]+", "", inspect.getdoc(obj) or "")
+
+
+def _public_members(mod: ModuleType):
+    """(classes, functions) defined in this module, public-name only.
+
+    ``__all__`` wins when present; otherwise non-underscore names whose
+    ``__module__`` matches (so re-exports are documented where they are
+    defined, not at every import site).
+    """
+    allowed = getattr(mod, "__all__", None)
+    classes, functions = [], []
+    for name in sorted(dir(mod)):
+        if allowed is not None:
+            if name not in allowed:
+                continue
+        elif name.startswith("_"):
+            continue
+        obj = getattr(mod, name)
+        if getattr(obj, "__module__", None) != mod.__name__:
+            # Re-export: only the package __init__ index mentions it.
+            continue
+        if inspect.isclass(obj):
+            classes.append((name, obj))
+        elif inspect.isfunction(obj):
+            functions.append((name, obj))
+    return classes, functions
+
+
+def _class_section(name: str, cls: type) -> list[str]:
+    lines = [f"### class `{name}{_signature(cls)}`", ""]
+    doc = _full_doc(cls)
+    if doc:
+        lines += [doc, ""]
+    methods = []
+    for mname in sorted(vars(cls)):
+        if mname.startswith("_"):
+            continue
+        member = inspect.getattr_static(cls, mname)
+        if isinstance(member, (staticmethod, classmethod)):
+            member = member.__func__
+        elif isinstance(member, property):
+            summary = _summary(member.fget) if member.fget else ""
+            methods.append((f"{mname} (property)", "", summary))
+            continue
+        if not inspect.isfunction(member):
+            continue
+        methods.append((mname, _signature(member), _summary(member)))
+    if methods:
+        lines += ["| method | summary |", "|---|---|"]
+        for mname, sig, summary in methods:
+            sig_md = f"`{mname}{sig}`" if sig else f"`{mname}`"
+            escaped = summary.replace("|", "\\|")
+            lines.append(f"| {sig_md} | {escaped} |")
+        lines.append("")
+    return lines
+
+
+def _function_section(name: str, fn) -> list[str]:
+    lines = [f"### `{name}{_signature(fn)}`", ""]
+    doc = _full_doc(fn)
+    if doc:
+        lines += [doc, ""]
+    return lines
+
+
+def _module_page(modname: str, mod: ModuleType) -> str | None:
+    classes, functions = _public_members(mod)
+    doc = _full_doc(mod)
+    is_pkg = hasattr(mod, "__path__")
+    if not (classes or functions) and not doc:
+        return None
+    lines = [f"# `{modname}`", ""]
+    if doc:
+        lines += [doc, ""]
+    if is_pkg:
+        allowed = getattr(mod, "__all__", None)
+        exports = [
+            n
+            for n in sorted(dir(mod))
+            if (allowed is None and not n.startswith("_"))
+            or (allowed is not None and n in allowed)
+        ]
+        if exports:
+            lines += [
+                "**Exports:** " + ", ".join(f"`{n}`" for n in exports),
+                "",
+            ]
+    for name, cls in classes:
+        lines += _class_section(name, cls)
+    for name, fn in functions:
+        lines += _function_section(name, fn)
+    return "\n".join(lines).rstrip() + "\n"
+
+
+def main() -> int:
+    sys.path.insert(0, str(REPO))
+    OUT.mkdir(parents=True, exist_ok=True)
+    pages: dict[str, str] = {}
+    for modname in _iter_modules():
+        mod = importlib.import_module(modname)
+        page = _module_page(modname, mod)
+        if page is not None:
+            pages[modname] = page
+
+    # Wipe stale pages so renames can't leave orphans behind.
+    for old in OUT.glob("*.md"):
+        old.unlink()
+    for modname, page in pages.items():
+        (OUT / f"{modname}.md").write_text(page)
+
+    # Index grouped by top-level subpackage.
+    groups: dict[str, list[str]] = {}
+    for modname in pages:
+        parts = modname.split(".")
+        group = parts[1] if len(parts) > 1 else "tpfl"
+        groups.setdefault(group, []).append(modname)
+    lines = [
+        "# API reference",
+        "",
+        "Generated from docstrings by `tools/gen_api_docs.py` — do not",
+        "edit by hand. Regenerate with:",
+        "",
+        "```bash",
+        "JAX_PLATFORMS=cpu python tools/gen_api_docs.py",
+        "```",
+        "",
+    ]
+    for group in sorted(groups):
+        lines.append(f"## {group}")
+        lines.append("")
+        for modname in sorted(groups[group]):
+            summary = pages[modname].split("\n")
+            first = next(
+                (ln for ln in summary[2:] if ln.strip()), ""
+            )
+            first = " ".join(first.split())
+            if len(first) > 100:
+                first = first[:97] + "..."
+            lines.append(f"- [`{modname}`]({modname}.md) — {first}")
+        lines.append("")
+    (OUT / "index.md").write_text("\n".join(lines).rstrip() + "\n")
+    print(f"wrote {len(pages) + 1} pages to {OUT}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -388,16 +388,19 @@ class FullModelCommand(NodeCommand):
         except Exception as e:
             logger.error(st.addr, f"FullModel decode failed: {e}")
             return
-        st.model_version += 1
-        st.last_full_model_round = max(st.last_full_model_round, round)
-        st.aggregated_model_event.set()
         # At-most-once per (node, round), atomically — concurrent
         # deliveries of the same round from two peers (gRPC runs
-        # handlers on a thread pool) must not both fan out.
+        # handlers on a thread pool) must not both fan out. The
+        # version bump shares the lock: an unsynchronized += from two
+        # handlers can lose a bump, leaving GossipModelStage's
+        # bytes-cache key pointing at a superseded payload.
         with st.relay_lock:
+            st.model_version += 1
+            st.last_full_model_round = max(st.last_full_model_round, round)
             do_relay = round > st.last_relayed_round
             if do_relay:
                 st.last_relayed_round = round
+        st.aggregated_model_event.set()
         if do_relay:
             # Relay OFF the handler thread: the in-memory transport
             # dispatches handlers synchronously in the sender's stack,

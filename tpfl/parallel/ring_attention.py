@@ -460,7 +460,7 @@ def ring_attention(
     v: jnp.ndarray,
     axis_name: str,
     causal: bool = False,
-    impl: str = "flash",
+    impl: str = "auto",
     block: int = 1024,
     interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
@@ -469,10 +469,16 @@ def ring_attention(
     ``axis_name``; K/V rotate the ring via ppermute. Returns the local
     output block.
 
-    ``impl="flash"`` (default) runs the Pallas flash kernel per ring
-    step with a ring-level recompute VJP (see module notes above);
-    ``impl="xla"`` keeps the plain einsum inner (reference/fallback
-    path, identical math)."""
+    ``impl="auto"`` (default) picks the Pallas flash kernel per ring
+    step with a ring-level recompute VJP (see module notes above) on
+    TPU, and the plain einsum inner elsewhere — Pallas interpret mode
+    is an emulator, orders of magnitude slower than XLA at real
+    sequence lengths, so non-TPU backends must not land on it by
+    default. ``impl="flash"`` forces the kernel (interpret-mode off
+    TPU — for exactness tests); ``impl="xla"`` forces the einsum inner
+    (identical math)."""
+    if impl == "auto":
+        impl = "flash" if jax.default_backend() == "tpu" else "xla"
     if impl == "xla":
         return _ring_xla(q, k, v, axis_name, causal)
     if interpret is None:
@@ -484,7 +490,7 @@ def make_ring_attention(
     mesh: Mesh,
     axis_name: str = "sp",
     causal: bool = False,
-    impl: str = "flash",
+    impl: str = "auto",
     block: int = 1024,
 ):
     """shard_map-wrapped ring attention: takes GLOBAL [B, S, H, D]
