@@ -35,6 +35,28 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30  # large-negative instead of -inf: exp() stays exact, no NaNs
 
 
+def _mm(a, b, dims):
+    """MXU matmul at the operands' NATIVE dtype with f32 accumulation.
+    bf16 inputs run the MXU at full rate; upcasting them to f32 first
+    (the r4 kernels did) runs every score/grad matmul at the f32 rate —
+    several times slower — for precision the f32 accumulator already
+    provides. f32 inputs (exactness tests) still compute fully in f32."""
+    if a.dtype != b.dtype:  # ring bwd: f32 cotangents, bf16 operands
+        wide = jnp.promote_types(a.dtype, b.dtype)
+        a, b = a.astype(wide), b.astype(wide)
+    return jax.lax.dot_general(
+        a, b, (dims, ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+def _lowp(ref):
+    """The dtype f32 intermediates must be cast back to before feeding
+    the next matmul: the ref's native dtype when it is low-precision
+    (bf16 path — the standard flash recipe rounds P/dS to bf16), f32
+    otherwise."""
+    return ref.dtype if ref.dtype == jnp.bfloat16 else jnp.float32
+
+
 def _fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
     *, block: int, causal: bool, scale: float,
@@ -53,12 +75,9 @@ def _fwd_kernel(
 
     @pl.when(run)
     def _attend():
-        q = q_ref[0].astype(jnp.float32) * scale  # [block, D]
-        k_j = k_ref[0].astype(jnp.float32)
-        v_j = v_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(  # [block, block] on the MXU
-            q, k_j, (((1,), (1,)), ((), ()))
-        )
+        # Native-dtype operands on the MXU, f32 scores out (_mm); the
+        # scale folds into the f32 scores, not the (possibly bf16) q.
+        s = _mm(q_ref[0], k_ref[0], ((1,), (1,))) * scale  # [block, block]
         if causal:
             q_pos = qi * block + jax.lax.broadcasted_iota(
                 jnp.int32, (block, block), 0
@@ -72,8 +91,8 @@ def _fwd_kernel(
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         corr = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
-        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
-            p, v_j, (((1,), (0,)), ((), ()))
+        acc_ref[:] = acc_ref[:] * corr + _mm(
+            p.astype(_lowp(v_ref)), v_ref[0], ((1,), (0,))
         )
         m_ref[:, :1] = m_new
         l_ref[:, :1] = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
@@ -106,11 +125,7 @@ def _dq_kernel(
 
     @pl.when(run)
     def _accumulate():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k_j = k_ref[0].astype(jnp.float32)
-        v_j = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k_j, (((1,), (1,)), ((), ())))
+        s = _mm(q_ref[0], k_ref[0], ((1,), (1,))) * scale
         if causal:
             q_pos = qi * block + jax.lax.broadcasted_iota(
                 jnp.int32, (block, block), 0
@@ -120,10 +135,10 @@ def _dq_kernel(
             )
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
         p = jnp.exp(s - lse_ref[0][:, :1])  # [blkq, blkk]
-        dp = jax.lax.dot_general(do, v_j, (((1,), (1,)), ((), ())))
+        dp = _mm(do_ref[0], v_ref[0], ((1,), (1,)))
         ds = p * (dp - dd_ref[0][:, :1])
-        dq_acc_ref[:] += jax.lax.dot_general(
-            ds, k_j, (((1,), (0,)), ((), ()))
+        dq_acc_ref[:] += _mm(
+            ds.astype(_lowp(k_ref)), k_ref[0], ((1,), (0,))
         )
 
     @pl.when(ki == nk - 1)
@@ -149,11 +164,7 @@ def _dkv_kernel(
 
     @pl.when(run)
     def _accumulate():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k_j = k_ref[0].astype(jnp.float32)
-        v_j = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k_j, (((1,), (1,)), ((), ())))
+        s = _mm(q_ref[0], k_ref[0], ((1,), (1,))) * scale
         if causal:
             q_pos = qi * block + jax.lax.broadcasted_iota(
                 jnp.int32, (block, block), 0
@@ -164,19 +175,19 @@ def _dkv_kernel(
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
         p = jnp.exp(s - lse_ref[0][:, :1])  # [blkq, blkk]
         # dV_j += P^T @ dO
-        dv_acc_ref[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ()))
-        )
-        dp = jax.lax.dot_general(do, v_j, (((1,), (1,)), ((), ())))
+        pl_ = p.astype(_lowp(do_ref))
+        dv_acc_ref[:] += _mm(pl_, do_ref[0], ((0,), (0,)))
+        dp = _mm(do_ref[0], v_ref[0], ((1,), (1,)))
         ds = p * (dp - dd_ref[0][:, :1])
-        # dK_j += dS^T @ (Q * scale)  (scale already folded into q)
-        dk_acc_ref[:] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ()))
+        # dK_j += scale · dS^T @ Q — scale applied at finalize (the
+        # f32 accumulator), not to the native-dtype q operand.
+        dk_acc_ref[:] += _mm(
+            ds.astype(_lowp(q_ref)), q_ref[0], ((0,), (0,))
         )
 
     @pl.when(qi == nq - 1)
     def _finalize():
-        dk_ref[0] = dk_acc_ref[:].astype(dk_ref.dtype)
+        dk_ref[0] = (dk_acc_ref[:] * scale).astype(dk_ref.dtype)
         dv_ref[0] = dv_acc_ref[:].astype(dv_ref.dtype)
 
 
