@@ -321,3 +321,36 @@ def test_scaffold_all_skipped_raises():
     agg = Scaffold("t")
     with pytest.raises(ValueError, match="num_samples == 0"):
         agg.aggregate([mk_model(1, 0, ["a"]), mk_model(2, 0, ["b"])])
+
+
+def test_stall_exit_detects_quiet_intake():
+    """Aggregator.stalled: fires only while the round is open, with at
+    least one contribution held, after intake has been quiet for the
+    stall window — the scale profile's early exit when an elected peer
+    never delivers (Settings.AGGREGATION_STALL)."""
+    import time as _time
+
+    agg = FedAvg("t")
+    agg.set_nodes_to_aggregate(["a", "b", "c"])
+    # Open round, nothing held yet: never stalled (nothing to salvage).
+    _time.sleep(0.3)
+    assert not agg.stalled(0.25)
+    agg.add_model(mk_model(1, 4, ["a"]))
+    # Generous window right after intake: immune to CI preemption
+    # (a tight window here would flake if the process is descheduled
+    # between add_model and the assert).
+    assert not agg.stalled(30.0)
+    _time.sleep(0.3)
+    assert agg.stalled(0.25)  # quiet past the window
+    assert not agg.stalled(60.0)  # but not for a generous window
+    agg.add_model(mk_model(2, 4, ["b"]))
+    assert not agg.stalled(30.0)  # fresh intake resets the clock
+    agg.add_model(mk_model(3, 4, ["c"]))
+    _time.sleep(0.3)
+    assert not agg.stalled(0.25)  # full coverage: round closed, not stalled
+    # And the partial result is aggregatable the moment it stalls.
+    agg2 = FedAvg("t2")
+    agg2.set_nodes_to_aggregate(["a", "b"])
+    agg2.add_model(mk_model(5, 4, ["a"]))
+    out = agg2.wait_and_get_aggregation(timeout=0.0)
+    np.testing.assert_allclose(np.asarray(out.get_parameters()["w"]), 5.0)

@@ -213,6 +213,28 @@ class ModelsAggregatedCommand(NodeCommand):
         self.state.set_models_aggregated(source, list(args))
 
 
+def send_models_aggregated(node: Any, covered: list[str]) -> None:
+    """Coverage announcements go DIRECTLY to train-set peers — the only
+    consumers (partial-push targeting and except-set computation). The
+    reference TTL-floods them to the whole network
+    (train_stage.py:119-176); at 1000 nodes that flood lags the direct
+    partial exchange by minutes, so senders compute except-sets from
+    stale coverage, peers drop the overlapping partials
+    (aggregator.add_model's double-count guard), and the trainers
+    fracture into different partial subsets — measured as every
+    trainer "proceeding without" a DIFFERENT peer that in fact trained
+    and gossiped. Direct sends keep coverage knowledge as fresh as the
+    payloads it steers. Shared by TrainStage (own fit) and
+    PartialModelCommand (intake)."""
+    st = node.state
+    msg = node.communication.build_msg(
+        ModelsAggregatedCommand.name, covered, round=st.round
+    )
+    for nei in st.train_set:
+        if nei != st.addr:
+            node.communication.send(nei, msg, create_connection=True)
+
+
 class ModelsReadyCommand(NodeCommand):
     """Peer finished its round (reference models_ready_command.py:26):
     accept round-1 or round; nei_status[source] = round."""
@@ -344,11 +366,8 @@ class PartialModelCommand(NodeCommand):
             return
         covered = self.node.aggregator.add_model(model)
         if covered:
-            self.node.communication.broadcast(
-                self.node.communication.build_msg(
-                    ModelsAggregatedCommand.name, covered, round=st.round
-                )
-            )
+            st.set_models_aggregated(st.addr, covered)
+            send_models_aggregated(self.node, covered)
 
 
 class FullModelCommand(NodeCommand):

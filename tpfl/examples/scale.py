@@ -97,13 +97,24 @@ def scale(args: argparse.Namespace) -> dict[str, float]:
     # monopolized by a vote flood or a batched-fit dispatch for tens of
     # seconds.
     Settings.HEARTBEAT_PERIOD = args.heartbeat_period
-    Settings.HEARTBEAT_TIMEOUT = max(120.0, 12 * args.heartbeat_period)
+    # The timeout must also scale with N: at 1000 single-core nodes
+    # the formation phase monopolizes the GIL long enough that beats
+    # starve past a flat 120 s, and the resulting eviction storm
+    # (~2000 false evictions measured) tears hub links out of the
+    # very topology the diffusion needs. In-process nodes cannot die
+    # unannounced, so a generous timeout costs nothing here.
+    Settings.HEARTBEAT_TIMEOUT = max(
+        120.0, 12 * args.heartbeat_period, 0.6 * args.nodes
+    )
     # Partial-model exchange among the elected trainers serializes on
-    # the GIL with every other node's threads: measured ~6 min to the
-    # first aggregate at 1000 single-core nodes. A flat 120 s wait
-    # makes nearly every node time out before an aggregate even
-    # exists; scale the budget with the federation size.
-    Settings.AGGREGATION_TIMEOUT = max(120.0, 0.6 * args.nodes)
+    # the GIL with every other node's threads. A flat 120 s wait makes
+    # nearly every node time out before an aggregate even exists, but
+    # an oversized budget is the round-length floor for every waiter
+    # the diffusion wave misses — with the stall exit forming partial
+    # aggregates early (Settings.AGGREGATION_STALL) and the epidemic
+    # relay covering ~99% of nodes within minutes, 0.3 s/node bounds
+    # the straggler tail without starving formation.
+    Settings.AGGREGATION_TIMEOUT = max(120.0, 0.3 * args.nodes)
 
     n = args.nodes
     ds = rendered_digits(

@@ -17,6 +17,7 @@ instead of the reference's host numpy loops.
 from __future__ import annotations
 
 import threading
+import time
 from abc import ABC, abstractmethod
 from typing import Any
 
@@ -56,6 +57,7 @@ class Aggregator(ABC):
         self._lock = threading.Lock()
         self._finish_aggregation_event = threading.Event()
         self._finish_aggregation_event.set()
+        self._last_intake = time.time()
         # Bumped on every state change (round start/end, model added).
         # Gossip loops key their encoded-payload caches on it: between
         # changes, a partial aggregate for the same except-set is
@@ -93,6 +95,7 @@ class Aggregator(ABC):
             self._train_set = list(nodes)
             self._models = []
             self.version += 1
+            self._last_intake = time.time()
             # Clear under the lock: a model arriving between the train-set
             # assignment and the clear would otherwise see the event still
             # set in add_model and be dropped at round start.
@@ -102,6 +105,22 @@ class Aggregator(ABC):
         """True while a round's aggregation is in progress (between
         set_nodes_to_aggregate and full coverage / clear)."""
         return not self._finish_aggregation_event.is_set()
+
+    def stalled(self, stall_seconds: float) -> bool:
+        """True when intake has gone quiet: the round is still open,
+        at least one contribution is held, and nothing new has arrived
+        for ``stall_seconds``. The scale profile uses this
+        (Settings.AGGREGATION_STALL) to let trainers proceed with a
+        partial aggregate when an elected peer is absent, instead of
+        burning the full AGGREGATION_TIMEOUT — measured at 1000
+        in-process nodes, the full-timeout wait for one never-arriving
+        trainer was the dominant term in round wall-clock."""
+        with self._lock:
+            return (
+                not self._finish_aggregation_event.is_set()
+                and bool(self._models)
+                and (time.time() - self._last_intake) > stall_seconds
+            )
 
     def clear(self) -> None:
         """End a round (reference RoundFinishedStage calls this)."""
@@ -163,6 +182,7 @@ class Aggregator(ABC):
                 return []
             self._models.append(model)
             self.version += 1
+            self._last_intake = time.time()
             covered |= set(contributors)
             logger.debug(
                 self.node_name,

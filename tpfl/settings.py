@@ -146,6 +146,24 @@ class Settings:
     observability only — so the scale profile turns it off (metrics
     still log locally; the experiment result does not depend on it)."""
 
+    AGGREGATION_STALL: float | None = None
+    """When set, a trainer whose aggregation intake has gone quiet for
+    this many seconds (holding at least one contribution, full
+    coverage not reached) proceeds with the partial aggregate instead
+    of waiting out AGGREGATION_TIMEOUT. None (default) = reference
+    behavior: wait the full timeout. The scale profile sets 60.0 —
+    at 1000 nodes an elected-but-unready peer otherwise costs every
+    trainer the entire timeout each round (measured: the dominant
+    round wall-clock term)."""
+
+    ROUND_WAIT_POLL: float = 0.5
+    """Upper bound (s) on the round-result wait's poll interval
+    (stages._await_round_result). FullModel arrival wakes waiters
+    instantly via the event; this bounds only how fast early-stop /
+    local-coverage conditions are noticed. The scale profile widens it
+    to 2.0 — hundreds of waiters waking 2x/s are a measurable GIL tax
+    at 1000 in-process nodes."""
+
     # --- determinism / TPU ---
     SEED: int | None = None
     """Global seed for reproducible experiments (fork feature)."""
@@ -213,9 +231,22 @@ class Settings:
         cls.GOSSIP_PERIOD = 0.0
         cls.GOSSIP_MESSAGES_PER_PERIOD = 100_000
         cls.AMOUNT_LAST_MESSAGES_SAVED = 100_000
-        cls.GOSSIP_MODELS_PERIOD = 0.05
+        # 0.25 s (not 0.05): every push tick's delivery runs the
+        # receiver's decode + jitted add_model in the sender's thread;
+        # at 0.05 s the 10 trainers' mutual exchange re-pushed
+        # payloads ~20x/s each and the redundant deliveries serialized
+        # on the GIL + device dispatch for minutes (measured at 1000
+        # nodes: 6 min to exchange 10 partials).
+        cls.GOSSIP_MODELS_PERIOD = 0.25
         cls.GOSSIP_MODELS_PER_ROUND = 20
-        cls.GOSSIP_EXIT_ON_X_EQUAL_ROUNDS = 50
+        cls.GOSSIP_EXIT_ON_X_EQUAL_ROUNDS = 20
+        # Safety net, not the normal exit: with coverage announcements
+        # going DIRECTLY to train-set peers the exchange completes
+        # coverage in seconds; the stall fires only when an elected
+        # peer genuinely never delivers. 60 s keeps slow-but-alive
+        # peers in (a 30 s stall measurably fractured the aggregate
+        # when it fired mid-exchange under flood-lagged coverage).
+        cls.AGGREGATION_STALL = 60.0
         # Heartbeats TTL-flood through relay hubs: at N nodes each beat
         # costs O(N) relays, so the beat rate — not the timeout — sets
         # the hub's floor load. 10s matches the standalone profile.
@@ -227,6 +258,11 @@ class Settings:
         cls.ASYNC_LOGGER = False
         cls.FILE_LOGGER = False
         cls.GOSSIP_METRICS = False
+        # Hundreds of round-result waiters waking 2x/s each is a
+        # standing GIL tax on the trainers forming the aggregate they
+        # wait for; the event still wakes them INSTANTLY on FullModel
+        # arrival — this bounds only early-stop detection latency.
+        cls.ROUND_WAIT_POLL = 2.0
 
     @classmethod
     def snapshot(cls) -> dict[str, Any]:

@@ -24,6 +24,7 @@ from tpfl.communication.commands import (
     ModelsReadyCommand,
     PartialModelCommand,
     VoteTrainSetCommand,
+    send_models_aggregated,
 )
 from tpfl.experiment import Experiment
 from tpfl.learning.aggregators.aggregator import NoModelsToAggregateError
@@ -247,11 +248,12 @@ def _await_round_result(
         if done_fn is not None and done_fn():
             return "done"
         # The event wakes this immediately on FullModel arrival; the
-        # timeout only bounds early-stop/done_fn detection latency.
-        # 0.5s (not 0.1s): at 1000 in-process nodes, ~990 waiters
-        # polling 10x/s were a ~10k-wakeups/s GIL tax on the very
-        # trainers forming the aggregate they wait for.
-        st.aggregated_model_event.wait(timeout=0.5)
+        # timeout only bounds early-stop/done_fn detection latency
+        # (Settings.ROUND_WAIT_POLL: 0.5 s default, 2.0 s in the scale
+        # profile — at 1000 in-process nodes, ~990 waiters polling
+        # 10x/s were a ~10k-wakeups/s GIL tax on the very trainers
+        # forming the aggregate they wait for).
+        st.aggregated_model_event.wait(timeout=Settings.ROUND_WAIT_POLL)
         st.aggregated_model_event.clear()
     return "timeout"
 
@@ -300,11 +302,9 @@ class TrainStage(Stage):
 
         covered = node.aggregator.add_model(fitted)
         st.set_models_aggregated(node.addr, covered)
-        node.communication.broadcast(
-            node.communication.build_msg(
-                ModelsAggregatedCommand.name, covered, round=st.round
-            )
-        )
+        # Directly to train-set peers, not a network-wide flood (see
+        # the helper's docstring for the measured fracture this fixes).
+        send_models_aggregated(node, covered)
 
         # Gossip partial aggregates to train-set peers still missing
         # contributors (reference :119-176; create_connection=True fully
@@ -381,9 +381,20 @@ class TrainStage(Stage):
         # last_full_model_round), the round is decided — adopt it
         # instead of burning the whole aggregation timeout.
         deadline = time.time() + Settings.AGGREGATION_TIMEOUT
-        status = _await_round_result(
-            node, deadline, done_fn=lambda: not node.aggregator.is_open()
-        )
+
+        def coverage_done() -> bool:
+            if not node.aggregator.is_open():
+                return True
+            # Stall exit (scale profile): intake has gone quiet with
+            # contributions held — an elected peer is absent; proceed
+            # with the partial aggregate now rather than burning the
+            # full timeout (the gossip exchange already ran to static
+            # before this wait, so a quiet aggregator means quiet
+            # peers, not an in-flight exchange).
+            stall = Settings.AGGREGATION_STALL
+            return stall is not None and node.aggregator.stalled(stall)
+
+        status = _await_round_result(node, deadline, done_fn=coverage_done)
         if status == "early_stop":
             node.aggregator.clear()
             return None
@@ -394,8 +405,16 @@ class TrainStage(Stage):
             )
         else:
             try:
+                # On a stall exit the event is unset and coverage will
+                # not complete — waiting out the remaining deadline
+                # would undo the early exit, so don't block again.
+                remaining = (
+                    0.0
+                    if (status == "done" and node.aggregator.is_open())
+                    else max(0.0, deadline - time.time())
+                )
                 agg_model = node.aggregator.wait_and_get_aggregation(
-                    timeout=max(0.0, deadline - time.time())
+                    timeout=remaining
                 )
             except NoModelsToAggregateError:
                 # Deliberate empty-round case: no result to diffuse.
