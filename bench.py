@@ -485,7 +485,7 @@ def main() -> None:
 
         sp_mesh = _cm({"sp": 1})
         for S, iters, impls in (
-            (8192, 96, ("flash", "xla")),
+            (8192, 192, ("flash", "xla")),
             (32768, 16, ("flash",)),
         ):
             for impl in impls:
