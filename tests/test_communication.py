@@ -429,3 +429,42 @@ def test_full_model_relay_on_first_adoption():
     cmd.execute("nb-other", 3, b"payload", ["a"], 10)
     wait_sends(2, timeout=1.0)
     assert len(sent) == 1
+
+
+def test_models_aggregated_targets_train_set_only():
+    """Coverage announcements are DIRECT sends to train-set peers — the
+    only consumers — never a network-wide broadcast (the reference
+    floods them; at scale the flood lag fractured the partial
+    exchange, see commands.send_models_aggregated)."""
+    from types import SimpleNamespace
+
+    from tpfl.communication.commands import send_models_aggregated
+
+    sent, broadcasts = [], []
+
+    class FakeComm:
+        def build_msg(self, cmd, args, round=None):
+            return {"cmd": cmd, "args": args, "round": round}
+
+        def send(self, dest, msg, create_connection=False):
+            sent.append((dest, msg, create_connection))
+
+        def broadcast(self, msg, node_list=None):
+            broadcasts.append(msg)
+
+    state = SimpleNamespace(
+        addr="me",
+        round=2,
+        train_set=["me", "peer-a", "peer-b"],
+    )
+    node = SimpleNamespace(state=state, communication=FakeComm())
+
+    send_models_aggregated(node, ["me", "peer-a"])
+
+    assert broadcasts == []  # never flooded
+    assert sorted(d for d, _, _ in sent) == ["peer-a", "peer-b"]  # not self
+    for _, msg, create_connection in sent:
+        assert msg["cmd"] == "models_aggregated"
+        assert msg["args"] == ["me", "peer-a"]
+        assert msg["round"] == 2
+        assert create_connection  # train set may not be dialed yet
