@@ -522,9 +522,9 @@ def test_ring_attention_matches_dense(causal, impl):
 def test_ring_attention_grads_flow():
     """Training through the ring: grads propagate through ppermute
     (sequence-parallel backprop)."""
-    from jax import shard_map
     from jax.sharding import PartitionSpec
 
+    from tpfl.parallel.compat import shard_map
     from tpfl.parallel.ring_attention import ring_attention
 
     mesh = create_mesh({"sp": 8})

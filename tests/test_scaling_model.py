@@ -13,6 +13,7 @@ import pytest
 
 from tpfl.models import CNN, MLP
 from tpfl.parallel import ShardedTrainer, VmapFederation, create_mesh
+from tpfl.parallel.compat import shard_map as _shard_map
 from tpfl.parallel.scaling import analyze_compiled, check_scaling, params_bytes
 
 WIDTHS = (1, 2, 4, 8)
@@ -165,7 +166,7 @@ def test_ring_attention_permute_bytes_are_local_block_sized():
     seen = {}
     for d in (2, 4, 8):
         mesh = create_mesh({"sp": d}, devices=jax.devices()[:d])
-        ring = jax.shard_map(
+        ring = _shard_map(
             partial(ring_attention, axis_name="sp", causal=True, impl="flash"),
             mesh=mesh, in_specs=(spec,) * 3, out_specs=spec,
             check_vma=False,
@@ -240,7 +241,7 @@ def test_moe_all_to_all_bytes_are_dispatch_buffer_sized():
     rng = np.random.default_rng(0)
     for d in (2, 4, 8):
         mesh = create_mesh({"ep": d}, devices=jax.devices()[:d])
-        moe = jax.shard_map(
+        moe = _shard_map(
             partial(
                 moe_dispatch,
                 expert_fn=lambda t: t * 2.0,
@@ -270,12 +271,17 @@ def test_federation_learner_dcn_bytes_independent_of_local_nodes():
     dcn = ge._dcn_wire_bytes_per_round(local_nodes=(2, 8))
     pbytes = next(iter(dcn.values()))["params_bytes"]
     payloads = [v["max_payload"] for v in dcn.values()]
-    totals = [v["weights_bytes"] for v in dcn.values()]
+    totals = [v["weights_bytes_unique"] for v in dcn.values()]
     # A few METADATA bytes may differ (msgpack varints of num_samples);
     # weight bytes may not.
     assert max(payloads) - min(payloads) <= 64, dcn
     assert 0 < max(payloads) <= 3 * pbytes, dcn
     assert max(totals) <= 3 * min(totals), dcn
+    # Both counting methods ride along (ADVICE r5): raw counts every
+    # transmission, unique dedups per-link retransmits — raw can never
+    # be smaller.
+    for v in dcn.values():
+        assert v["weights_bytes_raw"] >= v["weights_bytes_unique"] > 0, dcn
 
 
 def test_fsdp_aux_step_collective_bytes_independent_of_batch():

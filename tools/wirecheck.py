@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Wire-path lint: model payloads must go through the codec registry.
+
+Fails (exit 1) when any file under ``tpfl/`` serializes model payloads
+with raw ``serialization.encode_pytree`` / ``encode_model_payload`` /
+``msgpack.packb`` outside the allowlisted modules. A new code path that
+builds weight bytes by hand bypasses the versioned codec envelope
+(``tpfl/learning/compression.py``): its payloads would never quantize,
+never delta-encode, and — worse — old/new peers could stop agreeing on
+the wire format without any test noticing.
+
+Allowlist (each with a reason):
+
+- ``learning/serialization.py``   the v1 envelope implementation
+- ``learning/compression.py``     the v2 codec implementation
+- ``learning/model.py``           ``encode_parameters`` — the registry
+                                  dispatch itself (dense-vs-codec)
+- ``communication/message.py``    transport framing (control fields +
+                                  already-encoded payload bytes)
+- ``communication/grpc_transport.py``  RPC control frames and chunk
+                                  frames around already-encoded bytes
+- ``management/checkpoint.py``    on-DISK format, deliberately exact
+                                  (never rides the wire)
+
+Run: ``python tools/wirecheck.py`` (repo root inferred). Used by the
+test suite (tests/test_compression.py) so a violation fails CI.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+import sys
+
+ALLOWED = {
+    "tpfl/learning/serialization.py",
+    "tpfl/learning/compression.py",
+    "tpfl/learning/model.py",
+    "tpfl/communication/message.py",
+    "tpfl/communication/grpc_transport.py",
+    "tpfl/management/checkpoint.py",
+}
+
+# Raw serialization entry points a wire path must not touch directly.
+PATTERN = re.compile(
+    r"(?<![\w.])(?:serialization\.)?(?:encode_pytree|encode_model_payload)\s*\("
+    r"|msgpack\.packb\s*\("
+)
+
+
+def check(repo_root: "pathlib.Path | None" = None) -> list[str]:
+    """Return a list of 'path:line: offending text' violations."""
+    root = repo_root or pathlib.Path(__file__).resolve().parent.parent
+    violations: list[str] = []
+    for path in sorted((root / "tpfl").rglob("*.py")):
+        rel = path.relative_to(root).as_posix()
+        if rel in ALLOWED:
+            continue
+        for lineno, line in enumerate(
+            path.read_text(encoding="utf-8").splitlines(), 1
+        ):
+            stripped = line.split("#", 1)[0]
+            m = PATTERN.search(stripped)
+            if m is None:
+                continue
+            # compression.encode_model_payload IS the registry path.
+            if "compression.encode_model_payload" in stripped:
+                continue
+            violations.append(f"{rel}:{lineno}: {line.strip()}")
+    return violations
+
+
+def main() -> int:
+    violations = check()
+    if violations:
+        print(
+            "wirecheck FAILED — model payloads serialized outside the "
+            "codec registry (route through TpflModel.encode_parameters "
+            "or tpfl.learning.compression):",
+            file=sys.stderr,
+        )
+        for v in violations:
+            print(f"  {v}", file=sys.stderr)
+        return 1
+    print("wirecheck OK — all model payload paths go through the codec registry")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

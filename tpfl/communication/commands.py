@@ -370,6 +370,26 @@ class PartialModelCommand(NodeCommand):
             send_models_aggregated(self.node, covered)
 
 
+class CodecNackCommand(NodeCommand):
+    """Receiver could not decode our residual (delta) payload — it does
+    not hold the base round (or holds it with a different fingerprint).
+    Mark the peer so GossipModelStage sends it dense from now on; the
+    set resets with the experiment (NodeState.prepare_experiment). This
+    is the negotiation half of the codec-id byte: a peer that cannot
+    decode a codec tells us, instead of silently dropping payloads
+    forever."""
+
+    name = "codec_nack"
+
+    def execute(self, source: str, round: int, **kwargs: Any) -> None:
+        self.state.delta_nack_peers.add(source)
+        logger.debug(
+            self.state.addr,
+            f"{source} nacked a delta payload (round {round}); "
+            f"falling back to dense for it",
+        )
+
+
 class FullModelCommand(NodeCommand):
     """Aggregated round result arrives (reference
     full_model_command.py:31,46-89): set it and release the wait
@@ -397,6 +417,9 @@ class FullModelCommand(NodeCommand):
         num_samples: int,
         **kwargs: Any,
     ) -> None:
+        from tpfl.exceptions import DeltaBaseMismatchError
+        from tpfl.learning import compression
+
         st = self.state
         if st.round is None:
             return
@@ -404,9 +427,32 @@ class FullModelCommand(NodeCommand):
             return
         try:
             self.node.learner.set_model(weights)
+        except DeltaBaseMismatchError as e:
+            # Recoverable codec negotiation: tell the sender we lack the
+            # base; it re-sends dense (Settings.WIRE_DELTA docs).
+            logger.debug(st.addr, f"FullModel delta refused: {e}")
+            try:
+                self.node.communication.send(
+                    source,
+                    self.node.communication.build_msg(
+                        CodecNackCommand.name, [], round=round, ttl=1
+                    ),
+                    create_connection=True,
+                )
+            except Exception:
+                pass  # best-effort; the sender's push loop retries anyway
+            return
         except Exception as e:
             logger.error(st.addr, f"FullModel decode failed: {e}")
             return
+        # The adopted aggregate becomes the delta-gossip base for the
+        # NEXT round's pushes (and for decoding residuals sent to us).
+        try:
+            st.wire_bases.put(
+                round, self.node.learner.get_model().get_parameters()
+            )
+        except Exception as e:
+            logger.debug(st.addr, f"Base registration failed: {e}")
         # At-most-once per (node, round), atomically — concurrent
         # deliveries of the same round from two peers (gRPC runs
         # handlers on a thread pool) must not both fan out. The
@@ -443,10 +489,21 @@ class FullModelCommand(NodeCommand):
                     ]
                     if not lagging:
                         return
+                    relay_bytes = weights
+                    if compression.payload_is_delta(weights):
+                        # A residual payload only decodes against a base
+                        # WE held — a lagging neighbor (the relay's
+                        # whole audience) usually doesn't. Re-encode the
+                        # just-adopted full model through the configured
+                        # codec (no delta) instead of forwarding bytes
+                        # it will have to nack.
+                        relay_bytes = (
+                            node.learner.get_model().encode_parameters()
+                        )
                     payload = node.communication.build_weights(
                         FullModelCommand.name,
                         round,
-                        weights,
+                        relay_bytes,
                         contributors=contributors,
                         num_samples=num_samples,
                     )
@@ -484,4 +541,5 @@ ALL_COMMANDS = [
     InitModelCommand,
     PartialModelCommand,
     FullModelCommand,
+    CodecNackCommand,
 ]

@@ -15,24 +15,88 @@ generic service.
 
 from __future__ import annotations
 
+import itertools
 import socket
+import threading
+import zlib
 from concurrent import futures
-from typing import Any, Optional
+from typing import Any, Iterator, Optional
 
 import grpc
 import msgpack
 
 from tpfl.communication.base import ThreadedCommunicationProtocol
 from tpfl.communication.message import Message
-from tpfl.exceptions import CommunicationError
+from tpfl.exceptions import ChunkIntegrityError, CommunicationError
 from tpfl.management.logger import logger
 from tpfl.settings import Settings
 
 SERVICE = "tpfl.NodeServices"
 
+_stream_counter = itertools.count()
+_stream_counter_lock = threading.Lock()
+
+
+def _next_stream_id() -> int:
+    with _stream_counter_lock:
+        return next(_stream_counter)
+
 
 def _identity(b: bytes) -> bytes:
     return b
+
+
+def chunk_frames(data: bytes, chunk_size: int, sid: Optional[int] = None) -> Iterator[bytes]:
+    """Split one wire message into CRC-tagged stream frames:
+    ``{"sid", "seq", "n", "crc", "b"}``. Exposed for tests."""
+    if sid is None:
+        sid = _next_stream_id()
+    n = max(1, -(-len(data) // chunk_size))
+    for seq in range(n):
+        piece = data[seq * chunk_size: (seq + 1) * chunk_size]
+        yield msgpack.packb(
+            {
+                "sid": sid,
+                "seq": seq,
+                "n": n,
+                "crc": zlib.crc32(piece),
+                "b": piece,
+            },
+            use_bin_type=True,
+        )
+
+
+def reassemble_frames(frames: "Iterator[bytes]") -> bytes:
+    """Validate and join a chunk stream: per-chunk CRC, in-order
+    sequence, constant stream id, and a complete count — anything else
+    raises :class:`ChunkIntegrityError` (the whole stream is dropped;
+    gossip re-pushes). Exposed for tests."""
+    chunks: list[bytes] = []
+    sid: Optional[int] = None
+    total: Optional[int] = None
+    for raw in frames:
+        try:
+            frame = msgpack.unpackb(raw, raw=False)
+            f_sid, f_seq = frame["sid"], int(frame["seq"])
+            f_n, f_crc, piece = int(frame["n"]), frame["crc"], frame["b"]
+        except Exception as e:
+            raise ChunkIntegrityError(f"Malformed chunk frame: {e}") from e
+        if sid is None:
+            sid, total = f_sid, f_n
+        if f_sid != sid or f_n != total:
+            raise ChunkIntegrityError("Stream id/total changed mid-stream")
+        if f_seq != len(chunks):
+            raise ChunkIntegrityError(
+                f"Chunk gap: expected seq {len(chunks)}, got {f_seq}"
+            )
+        if zlib.crc32(piece) != f_crc:
+            raise ChunkIntegrityError(f"Chunk {f_seq} CRC mismatch")
+        chunks.append(piece)
+    if total is None or len(chunks) != total:
+        raise ChunkIntegrityError(
+            f"Truncated stream: {len(chunks)}/{total} chunks"
+        )
+    return b"".join(chunks)
 
 
 class AddressParser:
@@ -108,6 +172,18 @@ class GrpcCommunicationProtocol(ThreadedCommunicationProtocol):
                 request_deserializer=_identity,
                 response_serializer=_identity,
             ),
+            # Chunked weight transfers: a multi-MB model payload as ONE
+            # unary frame monopolizes the connection's flow-control
+            # window until fully transmitted — heartbeats and votes
+            # queue behind it (head-of-line). As a client stream of
+            # WIRE_CHUNK_SIZE frames, HTTP/2 interleaves other RPCs
+            # between chunks, and the receive side verifies each chunk's
+            # CRC before reassembly.
+            "SendStream": grpc.stream_unary_rpc_method_handler(
+                self._rpc_send_stream,
+                request_deserializer=_identity,
+                response_serializer=_identity,
+            ),
         }
         self._server = grpc.server(
             futures.ThreadPoolExecutor(
@@ -162,6 +238,22 @@ class GrpcCommunicationProtocol(ThreadedCommunicationProtocol):
             logger.error(self._addr, f"RPC send failed: {e}")
             return msgpack.packb({"ok": False, "error": str(e)})
 
+    def _rpc_send_stream(self, request_iterator: Any, context: Any) -> bytes:
+        try:
+            self.handle_message(
+                Message.from_bytes(reassemble_frames(request_iterator))
+            )
+            return msgpack.packb({"ok": True})
+        except ChunkIntegrityError as e:
+            # Corrupt/truncated stream: drop it whole — the sender's
+            # gossip loop re-pushes; a partial reassembly must never
+            # reach the decoder.
+            logger.error(self._addr, f"RPC stream rejected: {e}")
+            return msgpack.packb({"ok": False, "error": str(e)})
+        except Exception as e:
+            logger.error(self._addr, f"RPC stream failed: {e}")
+            return msgpack.packb({"ok": False, "error": str(e)})
+
     # --- client side (reference grpc_client.py / grpc_neighbors.py) ---
 
     def _dial(self, addr: str) -> Any:
@@ -193,6 +285,11 @@ class GrpcCommunicationProtocol(ThreadedCommunicationProtocol):
             )
             for name in ("Handshake", "Disconnect", "Send")
         }
+        stubs["SendStream"] = channel.stream_unary(
+            f"/{SERVICE}/SendStream",
+            request_serializer=_identity,
+            response_deserializer=_identity,
+        )
         return {"channel": channel, "stubs": stubs}
 
     def _handshake(self, addr: str, conn: Any) -> None:
@@ -203,9 +300,18 @@ class GrpcCommunicationProtocol(ThreadedCommunicationProtocol):
             raise CommunicationError(f"Handshake with {addr} refused")
 
     def _transport_send(self, addr: str, conn: Any, msg: Message) -> None:
-        resp = conn["stubs"]["Send"](
-            msg.to_bytes(), timeout=Settings.GRPC_TIMEOUT
-        )
+        data = msg.to_bytes()
+        chunk = Settings.WIRE_CHUNK_SIZE
+        if chunk and len(data) > chunk and "SendStream" in conn["stubs"]:
+            n_chunks = -(-len(data) // chunk)
+            # Timeout scales with the transfer: the unary GRPC_TIMEOUT
+            # is tuned for control messages, not a multi-MB model.
+            resp = conn["stubs"]["SendStream"](
+                chunk_frames(data, chunk),
+                timeout=Settings.GRPC_TIMEOUT * (1 + 0.25 * n_chunks),
+            )
+        else:
+            resp = conn["stubs"]["Send"](data, timeout=Settings.GRPC_TIMEOUT)
         out = msgpack.unpackb(resp, raw=False)
         if not out.get("ok"):
             raise CommunicationError(out.get("error", "unknown send error"))

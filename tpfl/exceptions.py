@@ -25,6 +25,17 @@ class DecodingParamsError(TpflError):
     """Serialized parameters could not be decoded."""
 
 
+class DeltaBaseMismatchError(DecodingParamsError):
+    """A residual (delta) payload referenced a base model this node does
+    not hold (or holds with a different fingerprint). Recoverable: the
+    receiver nacks and the sender falls back to a dense encode."""
+
+
+class ChunkIntegrityError(TpflError):
+    """A chunked wire stream failed reassembly (CRC mismatch, gap, or
+    truncation)."""
+
+
 class NodeNotRunning(TpflError):
     """A communication operation was attempted on a stopped node."""
 

@@ -57,7 +57,10 @@ class Aggregator(ABC):
         self._lock = threading.Lock()
         self._finish_aggregation_event = threading.Event()
         self._finish_aggregation_event.set()
-        self._last_intake = time.time()
+        # Monotonic, not wall clock: stalled() measures an interval, and
+        # an NTP step during a round would otherwise suppress the stall
+        # exit (clock jumps back) or fire it prematurely (jumps forward).
+        self._last_intake = time.monotonic()
         # Bumped on every state change (round start/end, model added).
         # Gossip loops key their encoded-payload caches on it: between
         # changes, a partial aggregate for the same except-set is
@@ -95,7 +98,7 @@ class Aggregator(ABC):
             self._train_set = list(nodes)
             self._models = []
             self.version += 1
-            self._last_intake = time.time()
+            self._last_intake = time.monotonic()
             # Clear under the lock: a model arriving between the train-set
             # assignment and the clear would otherwise see the event still
             # set in add_model and be dropped at round start.
@@ -114,12 +117,21 @@ class Aggregator(ABC):
         partial aggregate when an elected peer is absent, instead of
         burning the full AGGREGATION_TIMEOUT — measured at 1000
         in-process nodes, the full-timeout wait for one never-arriving
-        trainer was the dominant term in round wall-clock."""
+        trainer was the dominant term in round wall-clock.
+
+        Sizing the window: ``stall_seconds`` must comfortably exceed
+        the worst-case delivery time of a SINGLE partial payload
+        (encode + wire + decode + jitted add_model), or the exit fires
+        mid-exchange and fractures the aggregate (docs/deployment.md's
+        measured 30 s failure at 1000 nodes). Compressed wire codecs
+        (Settings.WIRE_CODEC) shrink that worst case ~4-5x, which adds
+        headroom at the same setting. Measured on ``time.monotonic()``
+        so NTP steps cannot suppress or prematurely fire it."""
         with self._lock:
             return (
                 not self._finish_aggregation_event.is_set()
                 and bool(self._models)
-                and (time.time() - self._last_intake) > stall_seconds
+                and (time.monotonic() - self._last_intake) > stall_seconds
             )
 
     def clear(self) -> None:
@@ -182,7 +194,7 @@ class Aggregator(ABC):
                 return []
             self._models.append(model)
             self.version += 1
-            self._last_intake = time.time()
+            self._last_intake = time.monotonic()
             covered |= set(contributors)
             logger.debug(
                 self.node_name,

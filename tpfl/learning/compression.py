@@ -1,0 +1,461 @@
+"""Pluggable wire codecs for model payloads.
+
+The v1 wire format (``serialization.py``) ships every weight transfer as
+a dense msgpack of raw leaf bytes — 4 bytes per f32 parameter, every
+round, to every sampled peer. At protocol scale the federation is
+gossip-bound, not compute-bound, so bytes-on-the-wire is the lever
+(PeerFL, arXiv:2405.17839). This module adds a **versioned, stacked
+codec layer**:
+
+- **int8 symmetric per-leaf quantization** (``quant8``): jitted
+  quantize/dequantize — ``scale = max|x| / 127`` per leaf, values as a
+  single int8 buffer; 4x on f32 before entropy coding.
+- **top-k sparsification** (``topk``): keep the ``WIRE_TOPK_FRAC``
+  largest-magnitude entries per leaf, packed as uint32 indices + values
+  (values themselves quantized when stacked with ``quant8``).
+- **entropy coding** (``zlib``/``zstd``): DEFLATE (or zstd when the
+  optional ``zstandard`` package exists — never a hard dep) over the
+  whole encoded body.
+- **residual (delta) payloads** (applied by callers that hold an
+  acknowledged base, see ``stages/base_node.py``): encode
+  ``current - base`` and let quantization work on the small residual.
+
+Wire envelope (version 2)::
+
+    b"\\x02" + bytes([codec_id]) + msgpack({
+        "body": <entropy-wrapped msgpack of the encoded params tree>,
+        "crc":  crc32(body),
+        "base_r": int,      # delta payloads only
+        "base_fp": bytes,   # delta payloads only
+        "contributors": [str, ...], "num_samples": int, "info": ...})
+
+The leading ``0x02`` version byte can never collide with a v1 payload
+(v1 is a msgpack map, first byte ``0x85``..), and the codec-id byte is
+readable without parsing the body — ``payload_version``/
+``payload_is_delta`` are O(1). Old peers keep decoding v1 dense
+payloads; new peers decode both.
+
+Codec ids are a bitmask (``QUANT8 | TOPK | ZLIB | ZSTD | DELTA``); named
+codec specs ("quant8+zlib") are parsed/validated by
+:func:`resolve_codec`. An unknown name raises ``ValueError`` at
+selection time, not mid-gossip.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import zlib
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import msgpack
+import numpy as np
+
+from tpfl.exceptions import DecodingParamsError, DeltaBaseMismatchError
+from tpfl.learning import serialization
+
+try:  # optional — never a hard dependency (container may not ship it)
+    import zstandard as _zstd
+except ImportError:
+    _zstd = None
+
+WIRE_VERSION_2 = 2
+_V2_PREFIX = bytes([WIRE_VERSION_2])
+
+# Codec-id bits (the byte negotiated in the envelope).
+QUANT8 = 0x01
+TOPK = 0x02
+ZLIB = 0x04
+ZSTD = 0x08
+DELTA = 0x10
+
+_PRIMITIVES = {
+    "dense": 0,
+    "quant8": QUANT8,
+    "topk": TOPK,
+    "zlib": ZLIB,
+    "zstd": ZSTD,
+}
+
+_Q8_KEY = "__q8__"
+_TK_KEY = "__tk__"
+
+
+def resolve_codec(spec: "str | int") -> int:
+    """Codec-id byte from a named spec ("dense", "quant8+zlib",
+    "topk+quant8+zstd") or a raw bitmask. Raises ``ValueError`` on
+    unknown names or an unavailable entropy backend (``zstd`` without
+    the ``zstandard`` package installed)."""
+    if isinstance(spec, int):
+        bits = spec
+    else:
+        bits = 0
+        for part in str(spec).replace(".", "+").split("+"):
+            part = part.strip().lower()
+            if part not in _PRIMITIVES:
+                raise ValueError(
+                    f"Unknown wire codec {part!r}; known: "
+                    f"{sorted(_PRIMITIVES)} (composed with '+')"
+                )
+            bits |= _PRIMITIVES[part]
+    if bits & ZSTD and _zstd is None:
+        raise ValueError(
+            "wire codec requests zstd but the 'zstandard' package is "
+            "not installed; use 'zlib' instead"
+        )
+    if bits & ZLIB and bits & ZSTD:
+        raise ValueError("pick one entropy coder: zlib or zstd, not both")
+    return bits
+
+
+def codec_name(bits: int) -> str:
+    """Human-readable name for a codec-id byte."""
+    parts = [n for n, b in _PRIMITIVES.items() if b and bits & b]
+    if bits & DELTA:
+        parts.append("delta")
+    return "+".join(parts) if parts else "dense"
+
+
+def is_dense(spec: "str | int") -> bool:
+    return resolve_codec(spec) == 0
+
+
+# --- jitted leaf kernels (arrays never bounce through Python loops) ---
+
+
+@jax.jit
+def _q8_encode(x):
+    x = x.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(x)) / 127.0
+    scale = jnp.where((scale > 0) & jnp.isfinite(scale), scale, 1.0)
+    q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+@jax.jit
+def _q8_decode(q, scale):
+    return q.astype(jnp.float32) * scale
+
+
+@partial(jax.jit, static_argnums=1)
+def _topk_encode(x, k):
+    flat = x.astype(jnp.float32).ravel()
+    _, idx = jax.lax.top_k(jnp.abs(flat), k)
+    return idx.astype(jnp.uint32), flat[idx]
+
+
+def _fp_update(h, arr: np.ndarray) -> None:
+    h.update(str(arr.dtype).encode())
+    h.update(str(arr.shape).encode())
+    h.update(np.ascontiguousarray(arr).tobytes())
+
+
+def pytree_fingerprint(tree: Any) -> bytes:
+    """Order-, shape- and dtype-sensitive digest of a params pytree —
+    the identity a delta payload's base is matched on. Both sides
+    compute it over the full model they hold; any bit difference makes
+    the receiver nack and the sender fall back to dense."""
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(tree):
+        _fp_update(h, np.asarray(leaf))
+    return h.digest()
+
+
+class BaseCache:
+    """Thread-safe round -> (fingerprint, host params) cache of adopted
+    full models — the delta-gossip bases. Bounded to the last few
+    rounds (a delta only ever references ``round - 1``)."""
+
+    KEEP = 3
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._bases: dict[int, tuple[bytes, Any]] = {}
+
+    def put(self, round: int, params: Any) -> None:
+        host = jax.tree_util.tree_map(np.asarray, params)
+        fp = pytree_fingerprint(host)
+        with self._lock:
+            self._bases[int(round)] = (fp, host)
+            for r in sorted(self._bases):
+                if len(self._bases) <= self.KEEP:
+                    break
+                del self._bases[r]
+
+    def get(self, round: int) -> Optional[tuple[bytes, Any]]:
+        with self._lock:
+            return self._bases.get(int(round))
+
+    def lookup(self, round: int, fingerprint: bytes) -> Optional[Any]:
+        hit = self.get(round)
+        if hit is None or hit[0] != fingerprint:
+            return None
+        return hit[1]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._bases.clear()
+
+
+# --- tree encode/decode ---
+
+
+def _is_array(obj: Any) -> bool:
+    return hasattr(obj, "__array__") and not isinstance(
+        obj, (bool, int, float, str)
+    )
+
+
+def _encode_leaf(a: np.ndarray, bits: int, topk_frac: float) -> Any:
+    """One array leaf -> codec record. Non-float, empty, and tiny
+    leaves stay dense (quantizing a 2-element bias saves nothing and
+    a scalar has no top-k)."""
+    dense = serialization._encode_obj(a)
+    if not (bits & (QUANT8 | TOPK)):
+        return dense
+    arr = np.asarray(a)
+    if arr.size == 0 or not jnp.issubdtype(arr.dtype, jnp.floating):
+        return dense
+    x = jnp.asarray(arr, jnp.float32)
+    rec: dict[str, Any] = {"d": arr.dtype.name, "s": list(arr.shape)}
+    if bits & TOPK and arr.size > 1:
+        k = max(1, int(np.ceil(arr.size * float(topk_frac))))
+        idx, vals = _topk_encode(x, k)
+        rec[_TK_KEY] = 1
+        rec["i"] = np.asarray(idx).tobytes()
+        if bits & QUANT8:
+            q, scale = _q8_encode(vals)
+            rec["q"] = np.asarray(q).tobytes()
+            rec["sc"] = float(scale)
+        else:
+            rec["v"] = np.asarray(vals, np.float32).tobytes()
+        return rec
+    if bits & QUANT8:
+        q, scale = _q8_encode(x)
+        rec[_Q8_KEY] = 1
+        rec["q"] = np.asarray(q).tobytes()
+        rec["sc"] = float(scale)
+        return rec
+    return dense
+
+
+def _decode_leaf(rec: dict) -> np.ndarray:
+    shape = tuple(rec["s"])
+    dtype = serialization._resolve_dtype(rec["d"])
+    if rec.get(_Q8_KEY) == 1:
+        q = np.frombuffer(rec["q"], np.int8).reshape(shape)
+        out = np.asarray(_q8_decode(jnp.asarray(q), rec["sc"]))
+        return out.astype(dtype)
+    # top-k: scatter values back into a zero leaf (vectorized)
+    idx = np.frombuffer(rec["i"], np.uint32).astype(np.int64)
+    if "q" in rec:
+        vals = np.frombuffer(rec["q"], np.int8).astype(np.float32) * rec["sc"]
+    else:
+        vals = np.frombuffer(rec["v"], np.float32)
+    size = int(np.prod(shape)) if shape else 1
+    if idx.size and (idx.max() >= size):
+        raise DecodingParamsError(
+            f"top-k index {int(idx.max())} out of bounds for leaf {shape}"
+        )
+    flat = np.zeros(size, np.float32)
+    flat[idx] = vals
+    return flat.reshape(shape).astype(dtype)
+
+
+def _encode_tree(obj: Any, bits: int, topk_frac: float) -> Any:
+    if _is_array(obj):
+        return _encode_leaf(np.asarray(obj), bits, topk_frac)
+    if isinstance(obj, dict):
+        return {k: _encode_tree(v, bits, topk_frac) for k, v in obj.items()}
+    if isinstance(obj, tuple):
+        return {
+            serialization._TUPLE_KEY: [
+                _encode_tree(v, bits, topk_frac) for v in obj
+            ]
+        }
+    if isinstance(obj, list):
+        return [_encode_tree(v, bits, topk_frac) for v in obj]
+    if obj is None or isinstance(obj, (bool, int, float, str, bytes)):
+        return obj
+    raise TypeError(f"Cannot serialize object of type {type(obj)}")
+
+
+def _decode_tree(obj: Any) -> Any:
+    if isinstance(obj, dict):
+        if obj.get(_Q8_KEY) == 1 or obj.get(_TK_KEY) == 1:
+            return _decode_leaf(obj)
+        if obj.get(serialization._ND_KEY) == 1:
+            return serialization._decode_obj(obj)
+        if serialization._TUPLE_KEY in obj and len(obj) == 1:
+            return tuple(
+                _decode_tree(v) for v in obj[serialization._TUPLE_KEY]
+            )
+        return {k: _decode_tree(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_decode_tree(v) for v in obj]
+    return obj
+
+
+# --- residuals ---
+
+
+def _residual_tree(params: Any, base: Any) -> Any:
+    """``params - base``, float leaves only (computed in f32; the
+    record keeps the original dtype name so decode restores it).
+    Non-float leaves ride dense at full value."""
+    def sub(p, b):
+        pa = np.asarray(p)
+        if pa.size and jnp.issubdtype(pa.dtype, jnp.floating):
+            return np.asarray(
+                jnp.asarray(pa, jnp.float32) - jnp.asarray(b, jnp.float32)
+            )
+        return p  # original object: non-float leaves ride unchanged
+
+    return jax.tree_util.tree_map(sub, params, base)
+
+
+def _apply_residual(residual: Any, base: Any) -> Any:
+    """``base + residual``; float leaves come back in the BASE's dtype
+    (the receiver's adopted model params carry the true dtypes — the
+    residual itself rides as f32)."""
+    def add(r, b):
+        ra = np.asarray(r)
+        if ra.size and jnp.issubdtype(ra.dtype, jnp.floating):
+            ba = np.asarray(b)
+            return np.asarray(
+                jnp.asarray(ba, jnp.float32) + jnp.asarray(ra, jnp.float32)
+            ).astype(ba.dtype)
+        return r  # original object: non-float leaves ride unchanged
+
+    return jax.tree_util.tree_map(add, residual, base)
+
+
+# --- entropy ---
+
+
+def _entropy_encode(body: bytes, bits: int, level: int) -> bytes:
+    if bits & ZSTD and _zstd is not None:
+        return _zstd.ZstdCompressor(level=max(1, level)).compress(body)
+    if bits & ZLIB:
+        return zlib.compress(body, level)
+    return body
+
+
+def _entropy_decode(body: bytes, bits: int) -> bytes:
+    if bits & ZSTD:
+        if _zstd is None:
+            raise DecodingParamsError(
+                "zstd payload received but the 'zstandard' package "
+                "is not installed"
+            )
+        try:
+            return _zstd.ZstdDecompressor().decompress(body)
+        except Exception as e:
+            raise DecodingParamsError(f"zstd decode failed: {e}") from e
+    if bits & ZLIB:
+        try:
+            return zlib.decompress(body)
+        except zlib.error as e:
+            raise DecodingParamsError(f"zlib decode failed: {e}") from e
+    return body
+
+
+# --- envelope ---
+
+
+def payload_version(data: bytes) -> int:
+    """1 for legacy dense payloads, 2 for codec envelopes. O(1)."""
+    return WIRE_VERSION_2 if data[:1] == _V2_PREFIX else 1
+
+
+def payload_codec(data: bytes) -> int:
+    """The envelope's codec-id byte (0 = dense v1). O(1)."""
+    return data[1] if payload_version(data) == WIRE_VERSION_2 else 0
+
+
+def payload_is_delta(data: bytes) -> bool:
+    """True when ``data`` is a residual payload that needs a base to
+    decode — relays must not forward it verbatim to peers that may not
+    hold the base. O(1): reads the codec-id byte only."""
+    return bool(payload_codec(data) & DELTA)
+
+
+def encode_model_payload(
+    params: Any,
+    contributors: list[str],
+    num_samples: int,
+    additional_info: dict[str, Any],
+    codec: "str | int",
+    delta_base: Optional[tuple[int, bytes, Any]] = None,
+    topk_frac: float = 0.05,
+    level: int = 1,
+) -> bytes:
+    """v2 wire envelope. ``delta_base`` is ``(round, fingerprint,
+    base_params)`` — when given, the body carries ``params - base`` and
+    the envelope names the base so the receiver can refuse a base it
+    does not hold (DeltaBaseMismatchError -> sender falls back dense)."""
+    bits = resolve_codec(codec)
+    env: dict[str, Any] = {
+        "contributors": list(contributors),
+        "num_samples": int(num_samples),
+        "info": serialization._encode_obj(additional_info),
+    }
+    tree = params
+    if delta_base is not None:
+        base_round, base_fp, base_params = delta_base
+        tree = _residual_tree(params, base_params)
+        bits |= DELTA
+        env["base_r"] = int(base_round)
+        env["base_fp"] = bytes(base_fp)
+    body = msgpack.packb(
+        _encode_tree(tree, bits, topk_frac), use_bin_type=True
+    )
+    body = _entropy_encode(body, bits, level)
+    env["body"] = body
+    env["crc"] = zlib.crc32(body)
+    return _V2_PREFIX + bytes([bits]) + msgpack.packb(env, use_bin_type=True)
+
+
+def decode_model_payload(
+    data: bytes,
+    bases: Optional[BaseCache] = None,
+) -> tuple[Any, list[str], int, dict[str, Any]]:
+    """Decode a v2 envelope. ``bases`` resolves delta payloads; a delta
+    without a matching base raises :class:`DeltaBaseMismatchError`
+    (recoverable — the protocol nacks and the sender re-sends dense)."""
+    if payload_version(data) != WIRE_VERSION_2:
+        raise DecodingParamsError("Not a v2 codec payload")
+    bits = data[1]
+    try:
+        env = msgpack.unpackb(data[2:], raw=False, strict_map_key=False)
+        body = env["body"]
+        if zlib.crc32(body) != env["crc"]:
+            raise DecodingParamsError("Payload body CRC mismatch")
+        tree = _decode_tree(
+            msgpack.unpackb(
+                _entropy_decode(body, bits), raw=False, strict_map_key=False
+            )
+        )
+        if bits & DELTA:
+            base_round, base_fp = int(env["base_r"]), env["base_fp"]
+            base = bases.lookup(base_round, base_fp) if bases else None
+            if base is None:
+                raise DeltaBaseMismatchError(
+                    f"Delta payload needs base round {base_round} "
+                    f"(fp {base_fp[:8].hex()}…) which this node does not hold"
+                )
+            tree = _apply_residual(tree, base)
+        return (
+            tree,
+            list(env["contributors"]),
+            int(env["num_samples"]),
+            serialization._decode_obj(env["info"]),
+        )
+    except DecodingParamsError:
+        raise
+    except (msgpack.UnpackException, ValueError, KeyError, TypeError,
+            AttributeError, IndexError) as e:
+        raise DecodingParamsError(f"Corrupt codec payload: {e}") from e

@@ -64,6 +64,11 @@ class TpflModel:
         self._contributors: list[str] = list(contributors or [])
         self.additional_info: dict[str, Any] = dict(additional_info or {})
         self.aux_state = aux_state
+        # Delta-gossip base resolver (tpfl.learning.compression.BaseCache,
+        # attached by Node at startup and inherited through build_copy):
+        # lets residual wire payloads decode against the round bases this
+        # node has adopted. None = delta payloads are refused.
+        self.base_store: Any = None
 
     # --- parameters ---
 
@@ -84,7 +89,9 @@ class TpflModel:
             self._check_and_set(params.get_parameters())
             return
         if isinstance(params, bytes):
-            decoded, contribs, n, info = serialization.decode_model_payload(params)
+            decoded, contribs, n, info = serialization.decode_model_payload(
+                params, bases=self.base_store
+            )
             self._check_and_set(decoded, restore_dtype=True)
             self._contributors = contribs
             self._num_samples = n
@@ -137,10 +144,39 @@ class TpflModel:
 
     # --- serialization (msgpack, not pickle) ---
 
-    def encode_parameters(self, params: Optional[Pytree] = None) -> bytes:
+    def encode_parameters(
+        self,
+        params: Optional[Pytree] = None,
+        codec: "str | int | None" = None,
+        delta_base: Optional[tuple] = None,
+    ) -> bytes:
+        """Wire-encode the parameters through the codec registry.
+
+        ``codec``: codec spec (``tpfl.learning.compression``); None =
+        ``Settings.WIRE_CODEC``. Callers that must stay exact regardless
+        of the configured wire codec (e.g. the process-isolation
+        round-trip) pass ``codec="dense"`` explicitly.
+
+        ``delta_base``: ``(round, fingerprint, base_params)`` — encode a
+        residual against an acknowledged base instead of the full
+        weights (GossipModelStage's delta-gossip path)."""
         from tpfl.settings import Settings
 
         params = params if params is not None else self._params
+        spec = Settings.WIRE_CODEC if codec is None else codec
+        from tpfl.learning import compression
+
+        if delta_base is not None or not compression.is_dense(spec):
+            return compression.encode_model_payload(
+                params,
+                self._contributors,
+                self._num_samples,
+                self.additional_info,
+                spec,
+                delta_base=delta_base,
+                topk_frac=Settings.WIRE_TOPK_FRAC,
+                level=Settings.WIRE_ENTROPY_LEVEL,
+            )
         if Settings.WIRE_DTYPE:
             # Wire compression: downcast float leaves (f32/f64) only;
             # ints/bools and already-narrow floats pass through. The
@@ -161,7 +197,9 @@ class TpflModel:
         )
 
     def decode_parameters(self, data: bytes) -> Pytree:
-        params, contribs, n, info = serialization.decode_model_payload(data)
+        params, contribs, n, info = serialization.decode_model_payload(
+            data, bases=self.base_store
+        )
         return params
 
     # --- FL metadata ---
@@ -208,9 +246,15 @@ class TpflModel:
             additional_info=copy.copy(kwargs.pop("additional_info", {})),
             aux_state=self.aux_state,
         )
+        # Wire-intake chain: aggregates/partials derive from a wire model
+        # via build_copy, and delta decodes anywhere downstream need the
+        # same base resolver.
+        m.base_store = self.base_store
         if params is not None:
             if isinstance(params, bytes):
-                decoded, contribs, n, info = serialization.decode_model_payload(params)
+                decoded, contribs, n, info = serialization.decode_model_payload(
+                    params, bases=self.base_store
+                )
                 # Wire intake (PartialModel/FullModel arrive through
                 # build_copy): restore this model's dtypes exactly like
                 # the direct set_parameters(bytes) path, or a

@@ -14,6 +14,11 @@ Wire envelope (version 1)::
      "contributors": [str, ...],
      "num_samples": int,
      "info": <encoded pytree>}
+
+Version 2 envelopes (compressed / residual payloads, leading ``0x02``
+byte — a v1 payload is a msgpack map and can never start with 0x02)
+live in :mod:`tpfl.learning.compression`; ``decode_model_payload``
+dispatches on the version so every decode site handles both.
 """
 
 from __future__ import annotations
@@ -103,7 +108,18 @@ def encode_model_payload(
     return msgpack.packb(env, use_bin_type=True)
 
 
-def decode_model_payload(data: bytes) -> tuple[Any, list[str], int, dict[str, Any]]:
+def decode_model_payload(
+    data: bytes, bases: Any = None
+) -> tuple[Any, list[str], int, dict[str, Any]]:
+    """Decode any wire version. v1 (legacy dense msgpack map) is handled
+    here; v2 codec envelopes (leading ``0x02`` version byte — quantized /
+    sparsified / entropy-coded / residual payloads) dispatch to
+    :mod:`tpfl.learning.compression`, with ``bases`` resolving residual
+    (delta) payloads to their base model."""
+    if data[:1] == b"\x02":
+        from tpfl.learning import compression
+
+        return compression.decode_model_payload(data, bases=bases)
     try:
         env = msgpack.unpackb(data, raw=False, strict_map_key=False)
         if env.get("v") != WIRE_VERSION:

@@ -95,6 +95,11 @@ class Node:
 
         self.learner = try_init_learner_with_simulation(self.learner)
 
+        # Delta-gossip wiring: every model derived from this one (wire
+        # intake via build_copy, aggregates) inherits the resolver, so
+        # residual payloads decode against the bases this node adopted.
+        self.learner.get_model().base_store = self.state.wire_bases
+
         # Experiment parameters (set by set_start_learning / command)
         self.rounds: int = 0
         self.epochs: int = 1

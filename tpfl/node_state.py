@@ -67,6 +67,16 @@ class NodeState:
         self.pending_partials: list[tuple] = []
         self.pending_partials_lock = threading.Lock()
 
+        # Delta-gossip wire state (tpfl.learning.compression): the
+        # round -> full-model bases this node has adopted (what residual
+        # payloads decode against), and the peers that nacked a delta
+        # (missing/mismatched base) — GossipModelStage sends those dense
+        # until the next experiment.
+        from tpfl.learning.compression import BaseCache
+
+        self.wire_bases = BaseCache()
+        self.delta_nack_peers: set[str] = set()
+
     # --- experiment delegation (reference node_state.py:84-97) ---
 
     @property
@@ -91,6 +101,11 @@ class NodeState:
         self.experiment.increase_round()
         with self.models_aggregated_lock:
             self.models_aggregated = {}
+        # Delta nacks are per-round hints, not a permanent downgrade: a
+        # peer that adopted round r VIA a residual holds a slightly
+        # different base than a dense receiver and will nack round
+        # r+1's delta once — after which it adopts dense and re-syncs.
+        self.delta_nack_peers = set()
 
     def stash_pending_partial(self, args: tuple, for_round: int) -> None:
         """Hold a next-round PartialModel until that round opens; stale
@@ -135,6 +150,8 @@ class NodeState:
             self.last_relayed_round = -1
         self.votes_ready_event.clear()
         self.aggregated_model_event.clear()
+        self.wire_bases.clear()
+        self.delta_nack_peers = set()
 
     def clear(self) -> None:
         """Reset to idle (reference node_state.py:125-127). Event

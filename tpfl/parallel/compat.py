@@ -1,0 +1,28 @@
+"""jax API compatibility shims for the parallel layer.
+
+``jax.shard_map`` (top-level, ``check_vma=`` kwarg) only exists on
+newer jax releases; older ones (e.g. 0.4.x) ship it as
+``jax.experimental.shard_map.shard_map`` with the kwarg spelled
+``check_rep``. Every shard_map call site in tpfl (and the driver's
+``__graft_entry__``) routes through :func:`shard_map` so one shim
+covers both APIs — without it the whole sp/pp/ep tier is an
+ImportError on the older runtime.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+
+def shard_map(f: Any, mesh: Any, in_specs: Any, out_specs: Any, **kw: Any):
+    """``jax.shard_map`` when available, else the experimental one with
+    ``check_vma=`` translated to its old ``check_rep=`` spelling."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+
+        if "check_vma" in kw:
+            kw["check_rep"] = kw.pop("check_vma")
+    return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)

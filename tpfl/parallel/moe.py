@@ -27,6 +27,8 @@ from functools import partial
 from typing import Any, Callable
 
 import jax
+
+from tpfl.parallel.compat import shard_map
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
@@ -175,7 +177,7 @@ def make_moe_train_layer(
     param_spec = PartitionSpec(axis_name)
     tok_spec = PartitionSpec(axis_name)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(
             _train_local,
             expert_fn=expert_fn,
@@ -252,7 +254,7 @@ def make_moe_layer(
             axis_name,
         )
 
-    fn = jax.shard_map(
+    fn = shard_map(
         local,
         mesh=mesh,
         in_specs=(param_spec, tok_spec),

@@ -31,6 +31,8 @@ from functools import partial
 from typing import Any, Callable, Optional
 
 import jax
+
+from tpfl.parallel.compat import shard_map
 import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
@@ -128,7 +130,7 @@ def make_pipeline(
         raise ValueError(f"{n_layers} layers do not split over {n} stages")
     param_spec = PartitionSpec(axis_name)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(pipeline_forward, block_fn, axis_name=axis_name),
         mesh=mesh,
         in_specs=(param_spec, PartitionSpec()),
@@ -171,7 +173,7 @@ def make_pipeline_trainer(
     param_spec = PartitionSpec(axis_name)
     opt = optimizer or optax.sgd(learning_rate)
 
-    fwd = jax.shard_map(
+    fwd = shard_map(
         partial(pipeline_forward, block_fn, axis_name=axis_name),
         mesh=mesh,
         in_specs=(param_spec, PartitionSpec()),

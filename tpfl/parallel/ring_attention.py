@@ -477,6 +477,14 @@ def ring_attention(
     default. ``impl="flash"`` forces the kernel (interpret-mode off
     TPU — for exactness tests); ``impl="xla"`` forces the einsum inner
     (identical math)."""
+    if impl not in ("auto", "flash", "xla"):
+        # Explicit rejection — an unknown impl silently falling through
+        # to the flash kernel would run interpret-mode Pallas off-TPU
+        # (orders of magnitude slower) with no hint why.
+        raise ValueError(
+            f"ring_attention impl must be one of 'auto', 'flash', 'xla'; "
+            f"got {impl!r}"
+        )
     if impl == "auto":
         impl = "flash" if jax.default_backend() == "tpu" else "xla"
     if impl == "xla":
@@ -496,7 +504,14 @@ def make_ring_attention(
     """shard_map-wrapped ring attention: takes GLOBAL [B, S, H, D]
     arrays sharded (or shardable) over ``axis_name`` on the sequence
     dimension, returns the global output with the same sharding."""
-    from jax import shard_map
+    if impl not in ("auto", "flash", "xla"):
+        # Validate at build time, not inside the traced shard_map body,
+        # so the error surfaces where the bad argument was written.
+        raise ValueError(
+            f"make_ring_attention impl must be one of 'auto', 'flash', "
+            f"'xla'; got {impl!r}"
+        )
+    from tpfl.parallel.compat import shard_map
 
     spec = PartitionSpec(None, axis_name, None, None)
 

@@ -120,7 +120,45 @@ class Settings:
     # = exact). Halves model-gossip bytes over DCN; receivers restore
     # their model's own dtype on set. Lossy (~3 decimal digits for
     # bf16) — FedAvg tolerates it, leave None for exact-repro runs.
+    # Applies to the DENSE codec only; WIRE_CODEC supersedes it.
     WIRE_DTYPE: str | None = None
+
+    # --- wire codec (model payload compression) ---
+    WIRE_CODEC: str = "dense"
+    """Model-payload wire codec (tpfl.learning.compression): "dense"
+    (v1 envelope, exact, what old peers decode), or a '+'-composed
+    stack of "quant8" (int8 symmetric per-leaf quantization, jitted),
+    "topk" (top-k magnitude sparsification, index+value packing) and
+    one entropy coder ("zlib", or "zstd" when the optional zstandard
+    package is installed). E.g. "quant8+zlib". Validated at use time —
+    unknown names raise ValueError. Lossy codecs are within FedAvg /
+    SCAFFOLD convergence noise on the digits/CIFAR paths (seeded A/B
+    in bench.py) at ≥4x fewer payload bytes."""
+
+    WIRE_TOPK_FRAC: float = 0.05
+    """Fraction of entries per leaf the "topk" codec keeps (by
+    magnitude). Only read when WIRE_CODEC includes "topk"."""
+
+    WIRE_ENTROPY_LEVEL: int = 1
+    """Compression level for the entropy stage (zlib/zstd). 1 favors
+    encode throughput — the gossip hot path encodes once per model
+    version but at a 1000-node hub every CPU cycle is contended."""
+
+    WIRE_DELTA: bool = False
+    """Residual (delta) gossip: once a round's aggregate is adopted it
+    becomes a BASE (tpfl.learning.compression.BaseCache); the next
+    round's full-model pushes to peers that acknowledged that base
+    (nei_status == round-1) carry only ``current - base``, which
+    quantizes/compresses far smaller than the full weights. A peer
+    without the base nacks (``codec_nack``) and the sender falls back
+    to dense for it — old peers and fresh joiners keep working."""
+
+    WIRE_CHUNK_SIZE: int = 256 * 1024
+    """gRPC payload chunking threshold AND chunk size (bytes). Messages
+    larger than this stream as CRC-tagged chunks over a dedicated
+    streaming RPC instead of one multi-MB unary frame, so heartbeats
+    and votes no longer queue behind a model transfer on the wire
+    (head-of-line). 0 disables chunking."""
 
     # --- SSL / mTLS ---
     USE_SSL: bool = False
@@ -154,7 +192,17 @@ class Settings:
     behavior: wait the full timeout. The scale profile sets 60.0 —
     at 1000 nodes an elected-but-unready peer otherwise costs every
     trainer the entire timeout each round (measured: the dominant
-    round wall-clock term)."""
+    round wall-clock term).
+
+    Sizing: the window must comfortably exceed the worst-case
+    single-payload delivery time (serialize + wire + decode + jitted
+    add_model of one partial model), or the stall fires MID-EXCHANGE
+    and fractures the aggregate — a 30 s stall did exactly that at
+    1000 nodes (docs/deployment.md). A lossy WIRE_CODEC (e.g.
+    "quant8+zlib", ~4-5x fewer payload bytes) shrinks that worst case
+    proportionally, buying stall-window headroom at the same
+    setting. Timed on the monotonic clock (Aggregator.stalled), so
+    NTP steps cannot suppress or prematurely fire the exit."""
 
     ROUND_WAIT_POLL: float = 0.5
     """Upper bound (s) on the round-result wait's poll interval
@@ -197,6 +245,11 @@ class Settings:
         cls.LOG_LEVEL = "DEBUG"
         cls.ASYNC_LOGGER = False
         cls.FILE_LOGGER = False
+        # Exactness first in tests: dense v1 payloads, no residual
+        # gossip; codec tests opt in explicitly.
+        cls.WIRE_CODEC = "dense"
+        cls.WIRE_DELTA = False
+        cls.WIRE_CHUNK_SIZE = 256 * 1024
 
     @classmethod
     def set_standalone_settings(cls) -> None:
@@ -216,6 +269,10 @@ class Settings:
         cls.AGGREGATION_TIMEOUT = 1200.0
         cls.WAIT_HEARTBEATS_CONVERGENCE = 4.0
         cls.LOG_LEVEL = "INFO"
+        # Single-host, handful of nodes: bytes are not the bottleneck —
+        # keep the exact dense wire (reference-parity behavior).
+        cls.WIRE_CODEC = "dense"
+        cls.WIRE_DELTA = False
 
     @classmethod
     def set_scale_settings(cls) -> None:
@@ -263,6 +320,13 @@ class Settings:
         # wait for; the event still wakes them INSTANTLY on FullModel
         # arrival — this bounds only early-stop detection latency.
         cls.ROUND_WAIT_POLL = 2.0
+        # The 1000-node runs are gossip-bound, not compute-bound:
+        # quantize + DEFLATE the weight payloads (~4-5x fewer bytes at
+        # convergence within noise — bench.py's seeded A/B) and ship
+        # round results as residuals against the previous round's
+        # aggregate wherever the peer acknowledged holding it.
+        cls.WIRE_CODEC = "quant8+zlib"
+        cls.WIRE_DELTA = True
 
     @classmethod
     def snapshot(cls) -> dict[str, Any]:

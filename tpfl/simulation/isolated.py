@@ -133,7 +133,9 @@ def _child_fit(payload: bytes) -> bytes:
     learner._round_counter = job["round_counter"]
     learner.set_epochs(job["epochs"])
     fitted = learner.fit()
-    return fitted.encode_parameters()
+    # Dense on purpose: this is a same-host process round-trip, not the
+    # gossip wire — a lossy WIRE_CODEC must not perturb the fit result.
+    return fitted.encode_parameters(codec="dense")
 
 
 def extract_job(learner: Any) -> Optional[bytes]:
@@ -161,7 +163,8 @@ def extract_job(learner: Any) -> Optional[bytes]:
         return None  # BatchNorm stats threading stays in-process
     try:
         module_bytes = pickle.dumps(model.module)
-        params = model.encode_parameters()
+        # Dense: in-process hand-off to the child, not wire traffic.
+        params = model.encode_parameters(codec="dense")
     except Exception:
         return None
     export_seed = (Settings.SEED or 0) + _addr_seed(learner.get_addr())

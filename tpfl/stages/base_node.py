@@ -447,6 +447,27 @@ class TrainStage(Stage):
                     st.last_full_model_round = max(
                         st.last_full_model_round, st.round
                     )
+                    # Register this round's delta-gossip base as the
+                    # WIRE ROUND-TRIP of our aggregate, not the exact
+                    # params: under a lossy codec a dense receiver holds
+                    # decode(encode(agg)), and the base fingerprints
+                    # must match bit-for-bit for next round's residual
+                    # pushes to be accepted. (Receivers register theirs
+                    # in FullModelCommand — the decoded params they
+                    # actually adopted. Exact codecs round-trip to the
+                    # same bits, so this is a no-op for "dense".)
+                    if Settings.WIRE_DELTA:
+                        try:
+                            rt = agg_model.build_copy(
+                                params=agg_model.encode_parameters()
+                            )
+                            st.wire_bases.put(
+                                st.round, rt.get_parameters()
+                            )
+                        except Exception as e:
+                            logger.debug(
+                                node.addr, f"Base round-trip failed: {e}"
+                            )
         node.communication.broadcast(
             node.communication.build_msg(
                 ModelsReadyCommand.name, [], round=st.round
@@ -537,7 +558,7 @@ class GossipModelStage(Stage):
                 if st.nei_status.get(n, -1) < st.round
             ]
 
-        # One encode per MODEL VERSION: per-push re-encodes
+        # One encode per (MODEL VERSION, wire form): per-push re-encodes
         # (device->host + msgpack each) would burn the GIL the
         # diffusion wave needs — same caching rule as TrainStage's
         # partial pushes and StartLearningStage's init payload. Keyed
@@ -545,24 +566,53 @@ class GossipModelStage(Stage):
         # entered holding its timed-out PARTIAL aggregate can receive
         # the round's authoritative FullModel mid-push, and the stale
         # cached bytes must not keep flowing (peers accept same-round
-        # FullModels unconditionally, and the relay forwards verbatim).
+        # FullModels unconditionally). Two wire forms per version at
+        # most: dense, and — under Settings.WIRE_DELTA — the residual
+        # against the previous round's aggregate for peers that
+        # acknowledged holding it (nei_status == round-1 via their
+        # ModelsReady broadcast). A peer missing the base nacks
+        # (CodecNackCommand) and drops back to the dense form.
         fullmodel_cache: dict = {}
 
         def model_for(nei: str) -> Optional[object]:
             version = st.model_version
             if fullmodel_cache.get("version") != version:
+                fullmodel_cache.clear()
+                fullmodel_cache["version"] = version
+            base = None
+            if (
+                Settings.WIRE_DELTA
+                and st.round is not None
+                and st.round > 0
+                and nei not in st.delta_nack_peers
+                and st.nei_status.get(nei, -2) == st.round - 1
+            ):
+                base = st.wire_bases.get(st.round - 1)  # (fp, params)
+            key = "delta" if base is not None else "dense"
+            hit = fullmodel_cache.get(key)
+            if hit is None:
                 model = node.learner.get_model()
                 try:
                     contributors = model.get_contributors()
                 except ValueError:
                     contributors = [node.addr]
-                fullmodel_cache["payload"] = (
-                    model.encode_parameters(),
-                    contributors,
-                    model.get_num_samples(),
-                )
-                fullmodel_cache["version"] = version
-            payload, contributors, num_samples = fullmodel_cache["payload"]
+                if base is not None:
+                    try:
+                        payload = model.encode_parameters(
+                            delta_base=(st.round - 1, base[0], base[1])
+                        )
+                    except Exception as e:
+                        # Structure drift vs the base (e.g. mid-run
+                        # model change) — residual impossible, go dense.
+                        logger.debug(
+                            node.addr, f"Delta encode failed, dense: {e}"
+                        )
+                        payload = model.encode_parameters()
+                else:
+                    payload = model.encode_parameters()
+                hit = (payload, contributors, model.get_num_samples())
+                fullmodel_cache[key] = hit
+            payload, contributors, num_samples = hit
             return node.communication.build_weights(
                 FullModelCommand.name,
                 st.round if st.round is not None else 0,
