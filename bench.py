@@ -47,6 +47,14 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
   wire vs the scale profile's "quant8+zlib" + residual broadcast),
   reporting total payload bytes, steady loss for both runs, and the
   ≥4x-bytes / ≤2%-loss acceptance booleans.
+- extra.chaos_*: chaos tier (communication/faults.py) —
+  chaos_determinism drives a fixed message schedule through the seeded
+  FaultInjector twice and reports per-round delivered/dropped counts
+  (identical for identical (seed, plan)); chaos_ab runs the seeded
+  digits federation fault-free and under 20% per-attempt drop with one
+  trainer crashed mid-round, reporting per-round wall time (must stay
+  under AGGREGATION_TIMEOUT — quorum degradation) and final loss (must
+  land within 5% of fault-free).
 
 ``--profile <dir>`` wraps the primary timed region in
 ``jax.profiler.trace`` (the TPU-native analog of the reference's opt-in
@@ -119,6 +127,171 @@ def _round_flops_estimate(fed_factory, input_shape, batch_shape, n_nodes,
     if not f1:
         return None
     return f1 * n_nodes * n_batches * epochs
+
+
+def _chaos_tier(extra: dict) -> None:
+    """Chaos tier (communication/faults.py). Two reports:
+
+    - extra.chaos_determinism: a fixed round-structured message
+      schedule driven twice through the seeded FaultInjector —
+      per-round delivered/dropped counts must come out identical
+      (and, being schedule-seeded, identical across bench invocations
+      with the same seed/plan).
+    - extra.chaos_ab: a live seeded digits federation run fault-free
+      and again under 20 % per-attempt drop on every link with one
+      trainer crashed mid-round — per-round wall time (must not burn
+      AGGREGATION_TIMEOUT: heartbeat loss shrinks the expected
+      contributor set) and final loss (must land within 5 % of
+      fault-free).
+    """
+    import numpy as np  # noqa: F401  (kept: symmetry with other tiers)
+
+    from tpfl.communication.faults import FaultInjector, FaultPlan
+    from tpfl.settings import Settings
+
+    CHAOS_SEED = 1234
+    PLAN = {"links": {"*->*": {"drop": 0.2}}}
+
+    try:
+        # (a) Determinism of the fault accounting itself.
+        def drive() -> list[list[int]]:
+            fi = FaultInjector(FaultPlan.from_dict(PLAN), seed=CHAOS_SEED)
+            links = [
+                (f"n{i}", f"n{j}") for i in range(3) for j in range(3) if i != j
+            ]
+            per_round = []
+            for _ in range(5):  # rounds
+                delivered = dropped = 0
+                for _ in range(40):  # messages per link per round
+                    for link in links:
+                        d = fi.decide(*link)
+                        if d.action == "drop":
+                            dropped += 1
+                        else:
+                            delivered += d.copies
+                per_round.append([delivered, dropped])
+            return per_round
+
+        first, second = drive(), drive()
+        extra["chaos_determinism"] = {
+            "seed": CHAOS_SEED,
+            "per_round_delivered_dropped": first,
+            "identical": first == second,
+        }
+
+        # (b) Live A/B: fault-free vs 20 % drop + one crashed trainer.
+        snap = Settings.snapshot()
+        try:
+            from tpfl.management.logger import logger as _logger
+
+            Settings.set_test_settings()
+            Settings.LOG_LEVEL = "ERROR"
+            _logger.set_level("ERROR")
+            Settings.ELECTION = "hash"  # n <= TRAIN_SET_SIZE: all elected
+            Settings.SEED = CHAOS_SEED
+
+            def run(inject: bool) -> dict:
+                from tpfl.learning.dataset import (
+                    RandomIIDPartitionStrategy,
+                    synthetic_mnist,
+                )
+                from tpfl.models import create_model
+                from tpfl.node import Node
+                from tpfl.utils import wait_convergence, wait_to_finish
+
+                n, rounds = 4, 6
+                ds = synthetic_mnist(
+                    n_train=200 * n, n_test=60, seed=0, noise=0.8
+                )
+                parts = ds.generate_partitions(
+                    n, RandomIIDPartitionStrategy, seed=1
+                )
+                nodes = [
+                    Node(
+                        create_model("mlp", (28, 28), seed=7, hidden_sizes=(32,)),
+                        parts[i],
+                        # Pinned addresses: learner shuffle seeds derive
+                        # from (Settings.SEED, addr) — auto-assigned
+                        # addrs increment per protocol instance, which
+                        # would give the two runs different data orders
+                        # and an incomparable loss.
+                        addr=f"chaos-{i}",
+                        learning_rate=0.05,
+                        batch_size=32,
+                    )
+                    for i in range(n)
+                ]
+                fi = None
+                if inject:
+                    fi = FaultInjector(
+                        FaultPlan.from_dict(PLAN), seed=CHAOS_SEED
+                    )
+                    for nd in nodes:
+                        fi.attach(nd.communication)
+                for nd in nodes:
+                    nd.start()
+                try:
+                    for nd in nodes[1:]:
+                        nodes[0].connect(nd.addr)
+                    wait_convergence(nodes, n - 1, only_direct=False, wait=10)
+                    t0 = time.monotonic()
+                    nodes[0].set_start_learning(rounds=rounds, epochs=1)
+                    if inject:
+                        # Crash the victim the moment it enters the
+                        # FINAL round's train set (before it can
+                        # contribute) — survivors must shrink the
+                        # expected contributor set and close on the
+                        # live members, not wait out the timeout.
+                        deadline = time.monotonic() + 60
+                        while time.monotonic() < deadline and not (
+                            (nodes[-1].state.round or 0) == rounds - 1
+                            and nodes[-1].state.train_set
+                        ):
+                            time.sleep(0.02)
+                        fi.crash(nodes[-1].addr)
+                    survivors = nodes[:-1] if inject else nodes
+                    wait_to_finish(survivors, timeout=240)
+                    elapsed = time.monotonic() - t0
+                    loss = float(
+                        survivors[0].learner.evaluate().get("test_loss", float("nan"))
+                    )
+                    stats = fi.stats() if fi is not None else {}
+                    return {
+                        "rounds": rounds,
+                        "elapsed_s": round(elapsed, 2),
+                        "per_round_s": round(elapsed / rounds, 2),
+                        "final_loss": round(loss, 4),
+                        "dropped": sum(
+                            s.get("dropped", 0) for s in stats.values()
+                        ),
+                        "delivered": sum(
+                            s.get("delivered", 0) for s in stats.values()
+                        ),
+                    }
+                finally:
+                    for nd in nodes:
+                        nd.stop()
+
+            ff = run(False)
+            ch = run(True)
+            rel = abs(ch["final_loss"] - ff["final_loss"]) / max(
+                abs(ff["final_loss"]), 1e-9
+            )
+            extra["chaos_ab"] = {
+                "plan": "20% drop all links + 1 trainer crashed mid-round",
+                "seed": CHAOS_SEED,
+                "fault_free": ff,
+                "chaos": ch,
+                "loss_rel_diff": round(rel, 4),
+                "loss_within_5pct": bool(rel <= 0.05),
+                "no_timeout_burn": bool(
+                    ch["per_round_s"] < Settings.AGGREGATION_TIMEOUT
+                ),
+            }
+        finally:
+            Settings.restore(snap)
+    except Exception as e:
+        extra["chaos_error"] = str(e)[:200]
 
 
 def main() -> None:
@@ -752,6 +925,10 @@ def main() -> None:
         }
     except Exception as e:
         extra["wire_codec_error"] = str(e)[:200]
+
+    # Chaos tier: deterministic fault accounting + live faulted A/B
+    # (extra.chaos_determinism / extra.chaos_ab).
+    _chaos_tier(extra)
 
     # Only quantitative anchor in the reference: 2-round MNIST e2e must
     # fit in 240 s (node_test.py:105) -> 0.00833 rounds/s floor.

@@ -354,3 +354,80 @@ def test_stall_exit_detects_quiet_intake():
     agg2.add_model(mk_model(5, 4, ["a"]))
     out = agg2.wait_and_get_aggregation(timeout=0.0)
     np.testing.assert_allclose(np.asarray(out.get_parameters()["w"]), 5.0)
+
+
+# --- quorum-based round degradation (Settings.ROUND_QUORUM) ---
+
+
+def test_remove_dead_nodes_shrinks_and_closes():
+    """Heartbeat loss mid-round: the expected contributor set shrinks
+    to the live members and aggregation closes once they all reported
+    — instead of waiting out AGGREGATION_TIMEOUT on a crashed peer."""
+    agg = FedAvg("t")
+    agg.set_nodes_to_aggregate(["a", "b", "c"])
+    agg.add_model(mk_model(1, 4, ["a"]))
+    assert agg.is_open()
+    # Dead peer with no contribution: removed; a+b still expected.
+    assert not agg.remove_dead_nodes(["c"])
+    assert agg.is_open()
+    assert agg.get_missing_models() == {"b"}
+    agg.add_model(mk_model(3, 4, ["b"]))
+    assert not agg.is_open()  # live set fully covered -> closed
+    out = agg.wait_and_get_aggregation(timeout=0.0)
+    np.testing.assert_allclose(np.asarray(out.get_parameters()["w"]), 2.0)
+
+
+def test_remove_dead_nodes_keeps_received_contribution():
+    """A member whose model already arrived is NOT removed on death —
+    its contribution is valid; only the expectation of more drops."""
+    agg = FedAvg("t")
+    agg.set_nodes_to_aggregate(["a", "b"])
+    agg.add_model(mk_model(2, 4, ["b"]))
+    assert not agg.remove_dead_nodes(["b"])  # already covered: kept
+    assert agg.get_missing_models() == {"a"}
+    agg.add_model(mk_model(4, 4, ["a"]))
+    assert not agg.is_open()
+    out = agg.wait_and_get_aggregation(timeout=0.0)
+    assert sorted(out.get_contributors()) == ["a", "b"]
+
+
+def test_removed_dead_member_readmitted_by_bundled_partial():
+    """Peers can shrink at different times: a partial aggregate that
+    still bundles a member we already declared dead must re-admit it
+    (its contribution is real), not be rejected — rejection would
+    deadlock the exchange and burn AGGREGATION_TIMEOUT."""
+    agg = FedAvg("t")
+    agg.set_nodes_to_aggregate(["a", "b", "c"])
+    agg.add_model(mk_model(1, 4, ["a"]))
+    assert not agg.remove_dead_nodes(["c"])  # we think c is dead
+    # A peer that received c's model before the crash pushes b+c.
+    agg.add_model(mk_model(4, 4, ["b", "c"]))
+    assert not agg.is_open()  # re-admitted and fully covered
+    out = agg.wait_and_get_aggregation(timeout=0.0)
+    assert sorted(out.get_contributors()) == ["a", "b", "c"]
+    # Sample-weighted mean: (4*1 + 4*4) / 8.
+    np.testing.assert_allclose(np.asarray(out.get_parameters()["w"]), 2.5)
+    # An unknown contributor is still rejected.
+    agg2 = FedAvg("t")
+    agg2.set_nodes_to_aggregate(["a", "b"])
+    assert agg2.add_model(mk_model(1, 4, ["a", "z"])) == []
+
+
+def test_round_quorum_closes_early():
+    """ROUND_QUORUM < 1.0 closes aggregation once the fraction of the
+    expected set has reported; the default 1.0 requires full coverage
+    (reference behavior)."""
+    from tpfl.settings import Settings
+
+    agg = FedAvg("t")
+    agg.set_nodes_to_aggregate(["a", "b", "c", "d"])
+    agg.add_model(mk_model(1, 4, ["a"]))
+    agg.add_model(mk_model(1, 4, ["b"]))
+    assert agg.is_open()  # 2/4 < default quorum 1.0
+    snap = Settings.ROUND_QUORUM
+    try:
+        Settings.ROUND_QUORUM = 0.75  # need ceil(0.75*4) = 3
+        agg.add_model(mk_model(1, 4, ["c"]))
+        assert not agg.is_open()  # 3/4 meets quorum
+    finally:
+        Settings.ROUND_QUORUM = snap

@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""Wire-path lint: model payloads must go through the codec registry.
+"""Wire-path lint: model payloads must go through the codec registry,
+and outbound RPCs must go through the retrying send path.
 
 Fails (exit 1) when any file under ``tpfl/`` serializes model payloads
 with raw ``serialization.encode_pytree`` / ``encode_model_payload`` /
@@ -8,6 +9,14 @@ builds weight bytes by hand bypasses the versioned codec envelope
 (``tpfl/learning/compression.py``): its payloads would never quantize,
 never delta-encode, and — worse — old/new peers could stop agreeing on
 the wire format without any test noticing.
+
+Second check (:func:`check_rpc`): no code outside the transport layer
+may invoke a gRPC stub/channel or call ``_transport_send`` directly.
+Every outbound message must flow through
+``ThreadedCommunicationProtocol.send`` — that is where retry/backoff,
+the circuit breaker, the fault injector, and the send-health counters
+live (``communication/base.py``); a raw ``conn["stubs"]["Send"](...)``
+call site would silently skip all four.
 
 Allowlist (each with a reason):
 
@@ -70,7 +79,55 @@ def check(repo_root: "pathlib.Path | None" = None) -> list[str]:
     return violations
 
 
+# --- RPC-path lint -------------------------------------------------------
+
+# The only module allowed to touch gRPC stubs/channels.
+RPC_ALLOWED = {
+    "tpfl/communication/grpc_transport.py",
+}
+
+# The only modules allowed to call the raw transport hook: base.py owns
+# the retrying dispatch (and the disconnect farewell, deliberately
+# fire-once); the transports implement the hook.
+SEND_ALLOWED = {
+    "tpfl/communication/base.py",
+    "tpfl/communication/grpc_transport.py",
+    "tpfl/communication/memory.py",
+}
+
+# Raw RPC entry points: stub tables, channel construction, stub calls.
+RPC_PATTERN = re.compile(
+    r"""\[['"]stubs['"]\]"""
+    r"|\.unary_unary\s*\("
+    r"|\.unary_stream\s*\("
+    r"|\.stream_unary\s*\("
+    r"|grpc\.(?:insecure|secure)_channel\s*\("
+)
+
+# Direct transport-hook calls (not the `def` lines that implement it).
+SEND_PATTERN = re.compile(r"\._transport_send(?:_corrupted)?\s*\(")
+
+
+def check_rpc(repo_root: "pathlib.Path | None" = None) -> list[str]:
+    """Return 'path:line: offending text' for outbound RPC call sites
+    that bypass the retrying send path."""
+    root = repo_root or pathlib.Path(__file__).resolve().parent.parent
+    violations: list[str] = []
+    for path in sorted((root / "tpfl").rglob("*.py")):
+        rel = path.relative_to(root).as_posix()
+        for lineno, line in enumerate(
+            path.read_text(encoding="utf-8").splitlines(), 1
+        ):
+            stripped = line.split("#", 1)[0]
+            if rel not in RPC_ALLOWED and RPC_PATTERN.search(stripped):
+                violations.append(f"{rel}:{lineno}: {line.strip()}")
+            elif rel not in SEND_ALLOWED and SEND_PATTERN.search(stripped):
+                violations.append(f"{rel}:{lineno}: {line.strip()}")
+    return violations
+
+
 def main() -> int:
+    rc = 0
     violations = check()
     if violations:
         print(
@@ -81,9 +138,28 @@ def main() -> int:
         )
         for v in violations:
             print(f"  {v}", file=sys.stderr)
-        return 1
-    print("wirecheck OK — all model payload paths go through the codec registry")
-    return 0
+        rc = 1
+    else:
+        print(
+            "wirecheck OK — all model payload paths go through the codec registry"
+        )
+    rpc_violations = check_rpc()
+    if rpc_violations:
+        print(
+            "wirecheck FAILED — raw RPC/transport call sites bypass the "
+            "retrying send path (route through "
+            "ThreadedCommunicationProtocol.send):",
+            file=sys.stderr,
+        )
+        for v in rpc_violations:
+            print(f"  {v}", file=sys.stderr)
+        rc = 1
+    else:
+        print(
+            "wirecheck OK — all outbound RPC call sites go through the "
+            "retrying send path"
+        )
+    return rc
 
 
 if __name__ == "__main__":

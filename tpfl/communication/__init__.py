@@ -13,12 +13,26 @@ on-device collectives (``tpfl.parallel``) while this layer keeps only
 the control plane.
 """
 
+from tpfl.communication.faults import (
+    CrashWindow,
+    FaultInjector,
+    FaultPlan,
+    LinkFaults,
+    Partition,
+)
+from tpfl.communication.memory import InMemoryCommunicationProtocol
 from tpfl.communication.message import Message
 from tpfl.communication.protocol import CommunicationProtocol
-from tpfl.communication.memory import InMemoryCommunicationProtocol
+from tpfl.communication.resilience import CircuitBreaker
 
 __all__ = [
     "Message",
     "CommunicationProtocol",
     "InMemoryCommunicationProtocol",
+    "FaultInjector",
+    "FaultPlan",
+    "LinkFaults",
+    "CrashWindow",
+    "Partition",
+    "CircuitBreaker",
 ]

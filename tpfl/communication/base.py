@@ -10,7 +10,10 @@ to dial a peer and how to push one message down the wire.
 
 from __future__ import annotations
 
+import random
 import threading
+import time
+import zlib
 from abc import abstractmethod
 from typing import Any, Optional
 
@@ -19,7 +22,12 @@ from tpfl.communication.heartbeater import HEARTBEAT_CMD, Heartbeater
 from tpfl.communication.message import Message
 from tpfl.communication.neighbors import Neighbors
 from tpfl.communication.protocol import CommandHandler, CommunicationProtocol
-from tpfl.exceptions import CommunicationError, NeighborNotConnectedError
+from tpfl.communication.resilience import CircuitBreaker, backoff_delay
+from tpfl.exceptions import (
+    ChunkIntegrityError,
+    CommunicationError,
+    NeighborNotConnectedError,
+)
 from tpfl.management.logger import logger
 from tpfl.settings import Settings
 
@@ -41,9 +49,28 @@ class ThreadedCommunicationProtocol(CommunicationProtocol):
             disconnect_fn=self._send_disconnect,
             close_fn=self._close_conn,
         )
-        self._gossiper = Gossiper(addr, self._gossip_send, self._neighbors.get_all)
+        # Send-health: retry jitter RNG (seeded per node), per-neighbor
+        # circuit breaker, and an optional chaos-test fault injector
+        # (None in production — see communication.faults).
+        self._breaker = CircuitBreaker(addr)
+        self._retry_rng = random.Random(
+            (Settings.SEED or 0) ^ zlib.crc32(addr.encode())
+        )
+        self._fault_injector: Any = None
+        self._gossiper = Gossiper(
+            addr,
+            self._gossip_send,
+            self._neighbors.get_all,
+            # Suspect peers don't eat flood budget; half-open probes
+            # re-admit them.
+            link_ok_fn=lambda nei: not self._breaker.is_open(nei),
+        )
         self._heartbeater = Heartbeater(
-            addr, self._neighbors, self.broadcast, self.build_msg
+            addr,
+            self._neighbors,
+            self.broadcast,
+            self.build_msg,
+            probe_fn=self._probe_suspects,
         )
         self.add_command(HEARTBEAT_CMD, self._heartbeat_handler)
         self.add_command(DISCONNECT_CMD, self._disconnect_handler)
@@ -61,6 +88,18 @@ class ThreadedCommunicationProtocol(CommunicationProtocol):
     @abstractmethod
     def _transport_send(self, addr: str, conn: Any, msg: Message) -> None:
         """Push one message down an open connection."""
+
+    def _transport_send_corrupted(self, addr: str, conn: Any, msg: Message) -> None:
+        """Fault-injection hook: deliver a deliberately corrupted copy
+        of ``msg`` and raise when the receiver's integrity check rejects
+        it (the expected outcome). Transports with a real wire override
+        this to exercise their actual checks — gRPC flips a byte inside
+        a CRC-tagged chunk frame; this default simulates the rejection
+        for wire-less transports (in-memory passes objects by
+        reference, so there are no bytes to flip)."""
+        raise ChunkIntegrityError(
+            f"fault-injected corruption to {addr} rejected (simulated)"
+        )
 
     def _close_conn(self, conn: Any) -> None:
         """Release a transport connection (default: nothing)."""
@@ -118,6 +157,9 @@ class ThreadedCommunicationProtocol(CommunicationProtocol):
         ok = self._neighbors.add(addr, non_direct=non_direct)
         if not ok:
             logger.info(self._addr, f"Cannot connect to {addr}")
+        else:
+            # An explicit (re)connect overrides suspicion.
+            self._breaker.on_peer_alive(addr)
         return ok
 
     def disconnect(self, addr: str, disconnect_msg: bool = True) -> None:
@@ -165,6 +207,14 @@ class ThreadedCommunicationProtocol(CommunicationProtocol):
         create_connection: bool = False,
         raise_error: bool = False,
     ) -> None:
+        if self._breaker.is_open(nei):
+            # Suspect peer (evicted after BREAKER_THRESHOLD consecutive
+            # failed sends): don't burn send budget; the half-open probe
+            # — or an incoming beat — re-admits it.
+            if raise_error:
+                raise NeighborNotConnectedError(f"{nei} circuit open (suspect)")
+            logger.debug(self._addr, f"Not sending to suspect {nei} (circuit open)")
+            return
         entry = self._neighbors.get(nei)
         conn = entry.conn if entry is not None else None
         ephemeral = False
@@ -205,16 +255,83 @@ class ThreadedCommunicationProtocol(CommunicationProtocol):
                 return
         try:
             msg.via = self._addr  # mark the hop (flood skip-back)
-            self._transport_send(nei, conn, msg)
+            attempts = self._send_with_retry(nei, conn, msg)
         except Exception as e:
-            # On-send-error eviction (reference grpc_client.py:176-183).
-            self._neighbors.remove(nei)
+            # Unlike the reference's on-first-error eviction
+            # (grpc_client.py:176-183), a failed send only counts
+            # against the breaker; eviction happens when
+            # BREAKER_THRESHOLD consecutive sends (each already
+            # retried) have failed — one lost packet is not a death.
+            opened = self._breaker.record_failure(
+                nei, attempts=max(1, int(Settings.RETRY_MAX_ATTEMPTS))
+            )
+            if opened:
+                self._neighbors.remove(nei)
+                logger.warning(
+                    self._addr,
+                    f"Circuit to {nei} opened after "
+                    f"{Settings.BREAKER_THRESHOLD} consecutive send "
+                    f"failures; evicted (last error: {e})",
+                )
             if raise_error:
                 raise CommunicationError(f"Send to {nei} failed: {e}")
             logger.debug(self._addr, f"Send to {nei} failed: {e}")
+        else:
+            self._breaker.record_success(nei, attempts=attempts)
         finally:
             if ephemeral:
                 self._close_conn(conn)
+
+    def _send_with_retry(self, nei: str, conn: Any, msg: Message) -> int:
+        """Run ``_dispatch_send`` with exponential backoff + jitter
+        (Settings.RETRY_*). Returns the attempts used; re-raises the
+        last error once the budget is exhausted. Retried deliveries are
+        safe: control messages dedup by hash at the receiver, weight
+        payloads by round/contributor bookkeeping."""
+        attempts = max(1, int(Settings.RETRY_MAX_ATTEMPTS))
+        for attempt in range(attempts):
+            try:
+                self._dispatch_send(nei, conn, msg)
+                return attempt + 1
+            except Exception as e:
+                if attempt + 1 >= attempts:
+                    raise
+                delay = backoff_delay(attempt, self._retry_rng)
+                logger.debug(
+                    self._addr,
+                    f"Send to {nei} failed ({e}); retry "
+                    f"{attempt + 1}/{attempts - 1} in {delay:.3f}s",
+                )
+                time.sleep(delay)
+        return attempts  # unreachable; keeps type-checkers honest
+
+    def _dispatch_send(self, nei: str, conn: Any, msg: Message) -> None:
+        """One transport attempt, routed through the fault injector when
+        one is attached (chaos tests/bench; None in production)."""
+        fi = self._fault_injector
+        if fi is None:
+            self._transport_send(nei, conn, msg)
+            return
+        decision = fi.decide(self._addr, nei)
+        if decision.action == "block":
+            raise CommunicationError(f"fault: link {self._addr}->{nei} is down")
+        if decision.action == "drop":
+            raise CommunicationError(f"fault: dropped {self._addr}->{nei}")
+        if decision.action == "corrupt":
+            try:
+                self._transport_send_corrupted(nei, conn, msg)
+            except Exception:
+                fi.count(self._addr, nei, "corrupt_rejected")
+                raise
+            # The receiver ACCEPTED corrupted bytes — an integrity hole
+            # the chaos tests assert never happens.
+            fi.count(self._addr, nei, "corrupt_accepted")
+            return
+        if decision.delay > 0:
+            time.sleep(decision.delay)
+        for _ in range(decision.copies):
+            self._transport_send(nei, conn, msg)
+        fi.count(self._addr, nei, "delivered", decision.copies)
 
     def broadcast(self, msg: Message, node_list: Optional[list[str]] = None) -> None:
         targets = node_list or list(self._neighbors.get_all(only_direct=True))
@@ -236,7 +353,12 @@ class ThreadedCommunicationProtocol(CommunicationProtocol):
     ) -> None:
         self._gossiper.gossip_weights(
             early_stopping_fn,
-            get_candidates_fn,
+            # Suspect (open-circuit) peers are not worth a model encode
+            # + push; they rejoin the candidate pool when a probe or
+            # beat re-admits them.
+            lambda: [
+                c for c in get_candidates_fn() if not self._breaker.is_open(c)
+            ],
             status_fn,
             model_fn,
             period=period,
@@ -249,6 +371,17 @@ class ThreadedCommunicationProtocol(CommunicationProtocol):
     # --- internals shared by all transports ---
 
     def _dial_and_handshake(self, addr: str) -> Any:
+        # Chaos: a blocked link (crashed/partitioned peer) must fail
+        # the dial too, or the half-open probe would "successfully"
+        # handshake an injector-crashed peer (the in-memory transport
+        # dials via a registry lookup, not the wire) and the breaker
+        # would flap evict -> re-admit -> evict for as long as the
+        # fault lasts.
+        fi = self._fault_injector
+        if fi is not None and fi.link_blocked(self._addr, addr):
+            raise CommunicationError(
+                f"fault: link {self._addr}->{addr} is down"
+            )
         conn = self._dial(addr)
         self._handshake(addr, conn)
         return conn
@@ -274,16 +407,46 @@ class ThreadedCommunicationProtocol(CommunicationProtocol):
         self._neighbors.remove(source, disconnect_msg=False)
 
     def _heartbeat_handler(self, source: str, args: list[str], **kwargs: Any) -> None:
+        # A beat is positive liveness evidence: close the source's
+        # circuit if it was suspect (a restarted peer that handshook us
+        # starts beating within one HEARTBEAT_PERIOD).
+        self._breaker.on_peer_alive(source)
         self._heartbeater.beat(source, args)
 
     def _gossip_send(self, nei: str, msg: Message) -> None:
         self.send(nei, msg)
+
+    def _probe_suspects(self) -> None:
+        """Half-open reconnect probes (heartbeater cadence): re-dial
+        each suspect peer at most once per BREAKER_PROBE_PERIOD; a
+        successful handshake re-admits it and closes the circuit."""
+        for addr in self._breaker.probe_due():
+            logger.info(self._addr, f"Half-open probe: re-dialing {addr}")
+            try:
+                ok = self._neighbors.add(addr, non_direct=False)
+            except Exception:
+                ok = False
+            if ok:
+                self._breaker.on_peer_alive(addr)
+                logger.info(
+                    self._addr, f"{addr} re-admitted (probe handshake succeeded)"
+                )
+
+    def get_transport_stats(self) -> dict[str, dict[str, Any]]:
+        """Per-neighbor send health: sends_ok / sends_failed / retries /
+        breaker_state / breaker_opens (also mirrored into
+        ``logger.transport_metrics``)."""
+        return self._breaker.snapshot()
 
     def handle_message(self, msg: Message) -> None:
         """Server receive path (reference grpc_server.py:161-215): dedup,
         dispatch, TTL re-flood."""
         if not self._started:
             return
+        if self._fault_injector is not None and self._fault_injector.is_down(
+            self._addr
+        ):
+            return  # chaos: a crashed node hears nothing
         if not msg.is_weights:
             if not self._gossiper.check_and_set_processed(msg.msg_hash):
                 return

@@ -281,6 +281,20 @@ class InitModelCommand(NodeCommand):
         st = self.state
         if st.model_initialized_event.is_set():
             logger.debug(st.addr, f"InitModel from {source} ignored (already init)")
+            # Anti-entropy repair: a redundant push means the sender
+            # never saw our one-shot ModelInitialized broadcast (lost
+            # on a lossy link). Re-announce directly to it, or its
+            # init gossip keeps pushing at us until its whole static
+            # window (INIT_GOSSIP_STATIC_EXIT_S) expires.
+            try:
+                self.node.communication.send(
+                    source,
+                    self.node.communication.build_msg(
+                        ModelInitializedCommand.name
+                    ),
+                )
+            except Exception as e:
+                logger.debug(st.addr, f"Re-announce to {source} failed: {e}")
             return
         if st.status != "Learning":
             # Reference parity (init_model_command.py:46-97: weights are

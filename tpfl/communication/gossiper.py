@@ -36,11 +36,17 @@ class Gossiper(threading.Thread):
         self_addr: str,
         send_fn: Callable[[str, Message], None],
         get_neighbors_fn: Callable[[bool], dict[str, Any]],
+        link_ok_fn: Optional[Callable[[str], bool]] = None,
     ) -> None:
         super().__init__(daemon=True, name=f"gossiper-{self_addr}")
         self._addr = self_addr
         self._send = send_fn
         self._get_neighbors = get_neighbors_fn
+        # Send-health filter (circuit breaker): a suspect peer must not
+        # eat per-period flood budget — at a relay hub one dead
+        # neighbor otherwise costs a (possibly retried) failed send for
+        # EVERY forwarded message until eviction.
+        self._link_ok = link_ok_fn or (lambda nei: True)
         self._pending: deque[Message] = deque()
         self._priority: deque[Message] = deque()
         self._pending_lock = threading.Lock()
@@ -105,7 +111,11 @@ class Gossiper(threading.Thread):
                 # One snapshot per batch: get_neighbors copies the table,
                 # and a relay hub forwards thousands of messages per
                 # round — per-message copies dominate otherwise.
-                neighbors = list(self._get_neighbors(True))
+                # Suspect (open-circuit) peers are filtered out here,
+                # not per send: same snapshot economics.
+                neighbors = [
+                    n for n in self._get_neighbors(True) if self._link_ok(n)
+                ]
             for msg in batch:
                 # Capture before sending: the transport overwrites
                 # msg.via with our own address at dispatch time.

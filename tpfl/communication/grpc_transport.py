@@ -27,7 +27,11 @@ import msgpack
 
 from tpfl.communication.base import ThreadedCommunicationProtocol
 from tpfl.communication.message import Message
-from tpfl.exceptions import ChunkIntegrityError, CommunicationError
+from tpfl.exceptions import (
+    ChunkIntegrityError,
+    CommunicationError,
+    ConnectionTimeoutError,
+)
 from tpfl.management.logger import logger
 from tpfl.settings import Settings
 
@@ -275,8 +279,14 @@ class GrpcCommunicationProtocol(ThreadedCommunicationProtocol):
                 timeout=max(Settings.GRPC_TIMEOUT * 4, 2.0)
             )
         except grpc.FutureTimeoutError:
+            # Typed, not a bare peer-drop: "slow or silent" (deadline
+            # expired) is distinct from "refused" — the retry layer
+            # backs off on it, and tests can assert which one happened.
             channel.close()
-            raise CommunicationError(f"Channel to {addr} not ready")
+            raise ConnectionTimeoutError(
+                f"Channel to {addr} not ready within "
+                f"{max(Settings.GRPC_TIMEOUT * 4, 2.0):.1f}s"
+            )
         stubs = {
             name: channel.unary_unary(
                 f"/{SERVICE}/{name}",
@@ -302,19 +312,49 @@ class GrpcCommunicationProtocol(ThreadedCommunicationProtocol):
     def _transport_send(self, addr: str, conn: Any, msg: Message) -> None:
         data = msg.to_bytes()
         chunk = Settings.WIRE_CHUNK_SIZE
-        if chunk and len(data) > chunk and "SendStream" in conn["stubs"]:
-            n_chunks = -(-len(data) // chunk)
-            # Timeout scales with the transfer: the unary GRPC_TIMEOUT
-            # is tuned for control messages, not a multi-MB model.
-            resp = conn["stubs"]["SendStream"](
-                chunk_frames(data, chunk),
-                timeout=Settings.GRPC_TIMEOUT * (1 + 0.25 * n_chunks),
-            )
-        else:
-            resp = conn["stubs"]["Send"](data, timeout=Settings.GRPC_TIMEOUT)
+        try:
+            if chunk and len(data) > chunk and "SendStream" in conn["stubs"]:
+                n_chunks = -(-len(data) // chunk)
+                # Timeout scales with the transfer: the unary GRPC_TIMEOUT
+                # is tuned for control messages, not a multi-MB model.
+                resp = conn["stubs"]["SendStream"](
+                    chunk_frames(data, chunk),
+                    timeout=Settings.GRPC_TIMEOUT * (1 + 0.25 * n_chunks),
+                )
+            else:
+                resp = conn["stubs"]["Send"](data, timeout=Settings.GRPC_TIMEOUT)
+        except grpc.RpcError as e:
+            if e.code() == grpc.StatusCode.DEADLINE_EXCEEDED:
+                raise ConnectionTimeoutError(
+                    f"RPC to {addr} exceeded its deadline"
+                ) from e
+            raise
         out = msgpack.unpackb(resp, raw=False)
         if not out.get("ok"):
             raise CommunicationError(out.get("error", "unknown send error"))
+
+    def _transport_send_corrupted(self, addr: str, conn: Any, msg: Message) -> None:
+        """Fault-injection hook (communication.faults): ship the message
+        as a chunk stream with one byte flipped in the final frame's
+        payload, so the receiver's REAL per-chunk CRC verification
+        (:func:`reassemble_frames`) does the rejecting — raised here as
+        :class:`CommunicationError` for the retry layer. Always streams
+        (even under the unary size threshold): the chunk CRC is the
+        integrity check under test."""
+        data = msg.to_bytes()
+        chunk = Settings.WIRE_CHUNK_SIZE or 64 * 1024
+        frames = list(chunk_frames(data, chunk))
+        # The msgpack frame packs "b" (the piece) last, so the final
+        # byte is payload — flipping it breaks that chunk's CRC.
+        bad = bytearray(frames[-1])
+        bad[-1] ^= 0x5A
+        frames[-1] = bytes(bad)
+        resp = conn["stubs"]["SendStream"](
+            iter(frames), timeout=Settings.GRPC_TIMEOUT * (1 + 0.25 * len(frames))
+        )
+        out = msgpack.unpackb(resp, raw=False)
+        if not out.get("ok"):
+            raise CommunicationError(out.get("error", "corrupted stream rejected"))
 
     def _close_conn(self, conn: Any) -> None:
         if conn is not None:

@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Callable
+from typing import Callable, Optional
 
 from tpfl.communication.message import Message
 from tpfl.communication.neighbors import Neighbors
@@ -38,12 +38,17 @@ class Heartbeater(threading.Thread):
         neighbors: Neighbors,
         broadcast_fn: Callable[[Message], None],
         build_msg_fn: Callable[..., Message],
+        probe_fn: Optional[Callable[[], None]] = None,
     ) -> None:
         super().__init__(daemon=True, name=f"heartbeater-{self_addr}")
         self._addr = self_addr
         self._neighbors = neighbors
         self._broadcast = broadcast_fn
         self._build_msg = build_msg_fn
+        # Circuit-breaker half-open probes ride the beat cadence: one
+        # liveness thread per node, not two (at 500 in-process nodes a
+        # second timer thread each is a real GIL tax).
+        self._probe = probe_fn
         self._stop_event = threading.Event()
 
     def beat(self, source: str, args: list[str]) -> None:
@@ -86,6 +91,11 @@ class Heartbeater(threading.Thread):
             evicted = self._neighbors.evict_stale(Settings.HEARTBEAT_TIMEOUT)
             for a in evicted:
                 logger.info(self._addr, f"Heartbeat timeout, evicted {a}")
+            if self._probe is not None:
+                try:
+                    self._probe()
+                except Exception as e:
+                    logger.debug(self._addr, f"Suspect probe failed: {e}")
             self._stop_event.wait(Settings.HEARTBEAT_PERIOD)
 
     def stop(self) -> None:

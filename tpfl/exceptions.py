@@ -46,3 +46,10 @@ class NeighborNotConnectedError(TpflError):
 
 class CommunicationError(TpflError):
     """Transport-level send/connect failure."""
+
+
+class ConnectionTimeoutError(CommunicationError):
+    """A dial or RPC deadline expired: the peer is *slow or silent*, as
+    opposed to actively refusing (connection refused / handshake
+    rejected, plain :class:`CommunicationError`). The retry layer backs
+    off and retries timeouts; tests can assert on the distinction."""

@@ -16,6 +16,7 @@ instead of the reference's host numpy loops.
 
 from __future__ import annotations
 
+import math
 import threading
 import time
 from abc import ABC, abstractmethod
@@ -54,6 +55,9 @@ class Aggregator(ABC):
         self.node_name = node_name
         self._train_set: list[str] = []
         self._models: list[TpflModel] = []
+        # Members dropped by remove_dead_nodes this round — a partial
+        # bundling one of them re-admits it (see add_model).
+        self._removed_dead: set[str] = set()
         self._lock = threading.Lock()
         self._finish_aggregation_event = threading.Event()
         self._finish_aggregation_event.set()
@@ -97,6 +101,7 @@ class Aggregator(ABC):
         with self._lock:
             self._train_set = list(nodes)
             self._models = []
+            self._removed_dead = set()
             self.version += 1
             self._last_intake = time.monotonic()
             # Clear under the lock: a model arriving between the train-set
@@ -134,11 +139,56 @@ class Aggregator(ABC):
                 and (time.monotonic() - self._last_intake) > stall_seconds
             )
 
+    def _covered_meets_quorum(self, covered: set[str]) -> bool:
+        """Caller holds ``self._lock``. True when ``covered`` satisfies
+        Settings.ROUND_QUORUM of the (possibly shrunk) expected set.
+        At the default 1.0 this is exactly ``covered ==
+        set(train_set)`` — reference behavior bit-for-bit."""
+        n = len(self._train_set)
+        if n == 0:
+            return False
+        need = max(1, math.ceil(Settings.ROUND_QUORUM * n - 1e-9))
+        return len(covered & set(self._train_set)) >= need
+
+    def remove_dead_nodes(self, addrs: list[str]) -> bool:
+        """Heartbeat loss evicted train-set members mid-round: shrink
+        the expected contributor set to the live members so aggregation
+        can close without burning AGGREGATION_TIMEOUT waiting on a
+        crashed trainer. Members whose contribution already arrived are
+        kept (their model is valid — only the *expectation* of more is
+        dropped); a late partial that still bundles a removed member's
+        contribution is rejected by add_model's subset check, keeping
+        the weighted mean consistent across peers that shrank at
+        different times. Returns True when the aggregation is (now)
+        closed."""
+        with self._lock:
+            if self._finish_aggregation_event.is_set():
+                return True
+            covered = {c for m in self._models for c in m.get_contributors()}
+            removable = [
+                a for a in addrs if a in self._train_set and a not in covered
+            ]
+            if removable:
+                self._train_set = [
+                    a for a in self._train_set if a not in removable
+                ]
+                self._removed_dead.update(removable)
+                self.version += 1
+                logger.warning(
+                    self.node_name,
+                    f"Dropping dead train-set members {removable}; "
+                    f"now expecting {self._train_set}",
+                )
+                if self._covered_meets_quorum(covered):
+                    self._finish_aggregation_event.set()
+            return self._finish_aggregation_event.is_set()
+
     def clear(self) -> None:
         """End a round (reference RoundFinishedStage calls this)."""
         with self._lock:
             self._train_set = []
             self._models = []
+            self._removed_dead = set()
             self.version += 1
         self._finish_aggregation_event.set()
 
@@ -172,12 +222,31 @@ class Aggregator(ABC):
             if not self._train_set:
                 logger.debug(self.node_name, "Dropping model: no train set")
                 return []
-            if not set(contributors).issubset(self._train_set):
-                logger.debug(
-                    self.node_name,
-                    f"Dropping model: contributors {contributors} not in train set",
-                )
-                return []
+            extras = set(contributors) - set(self._train_set)
+            if extras:
+                if extras <= self._removed_dead:
+                    # A peer that shrank later (or not at all) bundles a
+                    # member we declared dead. Its contribution is
+                    # real — rejecting it would deadlock the exchange
+                    # (that peer re-pushes the same partial until its
+                    # static-exit) and burn AGGREGATION_TIMEOUT here.
+                    # Re-admit: the member arrives covered by this very
+                    # model, so nothing new is awaited, and peers that
+                    # shrank at different times converge on the SAME
+                    # contributor set instead of diverging.
+                    self._train_set = list(self._train_set) + sorted(extras)
+                    self._removed_dead -= extras
+                    logger.warning(
+                        self.node_name,
+                        f"Re-admitting dead-dropped members {sorted(extras)}: "
+                        f"their contribution arrived via {contributors}",
+                    )
+                else:
+                    logger.debug(
+                        self.node_name,
+                        f"Dropping model: contributors {contributors} not in train set",
+                    )
+                    return []
             covered = {c for m in self._models for c in m.get_contributors()}
             if set(contributors).issubset(covered):
                 logger.debug(
@@ -200,7 +269,11 @@ class Aggregator(ABC):
                 self.node_name,
                 f"Model added ({len(covered)}/{len(self._train_set)}) from {contributors}",
             )
-            if covered == set(self._train_set):
+            # Quorum close (Settings.ROUND_QUORUM): at the default 1.0
+            # this fires exactly on full coverage (reference behavior);
+            # below 1.0 it closes once the configured fraction of the
+            # (possibly dead-shrunk) expected set has reported.
+            if self._covered_meets_quorum(covered):
                 self._finish_aggregation_event.set()
             return sorted(covered)
 

@@ -22,7 +22,11 @@ import os
 import queue
 from typing import Any, Optional
 
-from tpfl.management.metric_storage import GlobalMetricStorage, LocalMetricStorage
+from tpfl.management.metric_storage import (
+    GlobalMetricStorage,
+    LocalMetricStorage,
+    TransportMetricStorage,
+)
 from tpfl.settings import Settings
 
 #################
@@ -88,6 +92,10 @@ class TpflLogger:
 
         self.local_metrics = LocalMetricStorage()
         self.global_metrics = GlobalMetricStorage()
+        # Per-(node, neighbor) send health — fed by the circuit breaker
+        # (communication.resilience); surfaces sends_failed /
+        # breaker_state that previously vanished at debug level.
+        self.transport_metrics = TransportMetricStorage()
         # addr -> {"simulation": bool, "experiment": Experiment | None, "round": int | None}
         self._nodes: dict[str, dict[str, Any]] = {}
 
@@ -165,6 +173,11 @@ class TpflLogger:
 
     def get_global_logs(self):
         return self.global_metrics.get_all_logs()
+
+    def get_transport_logs(self):
+        """node -> neighbor -> send-health counters (sends_ok,
+        sends_failed, retries, breaker_state, breaker_opens)."""
+        return self.transport_metrics.get_all_logs()
 
     # --- node registry (reference logger.py:342-372) ---
 

@@ -8,6 +8,11 @@ Parity with reference ``p2pfl/management/metric_storage.py``:
 - :class:`GlobalMetricStorage` — per-round evaluation metrics,
   ``exp -> node -> metric -> [(round, value)]`` with per-round dedup
   (reference ``metric_storage.py:158,208-210``).
+- :class:`TransportMetricStorage` — per-(node, neighbor) send-health
+  counters (``sends_ok`` / ``sends_failed`` / ``retries`` /
+  ``breaker_state``), fed by the communication layer's circuit breaker
+  so dropped gossip/heartbeat sends are observable instead of
+  vanishing at debug level (tpfl addition, no reference analog).
 
 Thread-safe: gRPC handler threads, the learning thread, and the monitor
 thread all log concurrently.
@@ -90,3 +95,56 @@ class GlobalMetricStorage:
     def get_experiment_node_logs(self, exp: str, node: str) -> dict:
         with self._lock:
             return copy.deepcopy(self._store.get(exp, {}).get(node, {}))
+
+
+TransportMetrics = dict[str, dict[str, dict[str, object]]]
+
+
+class TransportMetricStorage:
+    """node -> neighbor -> {sends_ok, sends_failed, retries,
+    breaker_state, breaker_opens}
+
+    Counters survive neighbor eviction/re-admission (they describe the
+    link's history, not the table entry), and reset only with the
+    process — they answer "how flaky has this link been", which a
+    per-round store cannot."""
+
+    def __init__(self) -> None:
+        self._store: TransportMetrics = {}
+        self._lock = threading.Lock()
+
+    def _entry(self, node: str, neighbor: str) -> dict[str, object]:
+        nd = self._store.setdefault(node, {})
+        e = nd.get(neighbor)
+        if e is None:
+            e = nd[neighbor] = {
+                "sends_ok": 0,
+                "sends_failed": 0,
+                "retries": 0,
+                "breaker_state": "closed",
+                "breaker_opens": 0,
+            }
+        return e
+
+    def record_send(
+        self, node: str, neighbor: str, ok: bool, attempts: int = 1
+    ) -> None:
+        with self._lock:
+            e = self._entry(node, neighbor)
+            e["sends_ok" if ok else "sends_failed"] += 1  # type: ignore[operator]
+            e["retries"] += max(0, attempts - 1)  # type: ignore[operator]
+
+    def record_breaker(self, node: str, neighbor: str, state: str) -> None:
+        with self._lock:
+            e = self._entry(node, neighbor)
+            e["breaker_state"] = state
+            if state == "open":
+                e["breaker_opens"] += 1  # type: ignore[operator]
+
+    def get_all_logs(self) -> TransportMetrics:
+        with self._lock:
+            return copy.deepcopy(self._store)
+
+    def get_node_logs(self, node: str) -> dict:
+        with self._lock:
+            return copy.deepcopy(self._store.get(node, {}))

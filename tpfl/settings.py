@@ -65,6 +65,37 @@ class Settings:
     (grpc_server.py:67); a multislice host fanning out to tens of peers
     serializes handler work at that width — raise for dense hubs."""
 
+    # --- transport resilience (retry / circuit breaker) ---
+    RETRY_MAX_ATTEMPTS: int = 3
+    """Total attempts per outbound send (unary and streamed): 1 = the
+    reference's fire-once behavior. Retries are safe — control messages
+    dedup by hash at the receiver, weight payloads by round/contributor
+    bookkeeping — so a duplicate delivery from a retried send that
+    actually arrived is absorbed."""
+
+    RETRY_BASE_DELAY: float = 0.05
+    """Backoff before retry k is ``min(RETRY_MAX_DELAY,
+    RETRY_BASE_DELAY * 2**k)`` scaled by equal jitter in [0.5, 1.5)
+    drawn from a per-node seeded RNG (deterministic under
+    Settings.SEED)."""
+
+    RETRY_MAX_DELAY: float = 2.0
+    """Cap on a single backoff sleep (seconds)."""
+
+    BREAKER_THRESHOLD: int = 3
+    """Consecutive *failed sends* (each already retried
+    RETRY_MAX_ATTEMPTS times) to a neighbor before its circuit opens:
+    the peer is marked suspect, evicted from the table, and no longer
+    costs send budget. The reference evicts on the FIRST failed send
+    (grpc_client.py:176-183), which a single lost packet can trigger."""
+
+    BREAKER_PROBE_PERIOD: float = 10.0
+    """Seconds between half-open reconnect probes to a suspect peer
+    (rides the heartbeater cadence, so the effective period is
+    ``max(BREAKER_PROBE_PERIOD, HEARTBEAT_PERIOD)``). A successful
+    probe handshake — or an incoming beat from the peer — closes the
+    circuit and re-admits it."""
+
     # --- logging ---
     LOG_LEVEL: str = "INFO"
     FILE_LOGGER: bool = True
@@ -174,6 +205,19 @@ class Settings:
     AGGREGATION_TIMEOUT: float = 300.0
     WAIT_HEARTBEATS_CONVERGENCE: float = 0.2
 
+    ROUND_QUORUM: float = 1.0
+    """Fraction of the *live* train set whose contributions close a
+    round's aggregation. 1.0 (default) = reference behavior: every
+    expected contributor must report (or the deadline/stall fires).
+    When heartbeat loss evicts a train-set member mid-round the
+    expected set shrinks to the live members
+    (Aggregator.remove_dead_nodes), so a crashed trainer no longer
+    costs every peer the full AGGREGATION_TIMEOUT; ROUND_QUORUM < 1.0
+    additionally lets aggregation close before slow-but-alive members
+    report — use with care: unlike AGGREGATION_STALL it does not wait
+    for intake to go quiet, so an aggressive quorum can fracture the
+    aggregate mid-exchange exactly like an undersized stall window."""
+
     # --- observability ---
     RESOURCE_MONITOR_PERIOD: float = 1.0
 
@@ -250,6 +294,15 @@ class Settings:
         cls.WIRE_CODEC = "dense"
         cls.WIRE_DELTA = False
         cls.WIRE_CHUNK_SIZE = 256 * 1024
+        # Fault tolerance: short backoffs (tests run against loopback),
+        # fast half-open probes; quorum at reference behavior — chaos
+        # tests override per-case.
+        cls.RETRY_MAX_ATTEMPTS = 2
+        cls.RETRY_BASE_DELAY = 0.05
+        cls.RETRY_MAX_DELAY = 0.25
+        cls.BREAKER_THRESHOLD = 3
+        cls.BREAKER_PROBE_PERIOD = 1.0
+        cls.ROUND_QUORUM = 1.0
 
     @classmethod
     def set_standalone_settings(cls) -> None:
@@ -273,6 +326,14 @@ class Settings:
         # keep the exact dense wire (reference-parity behavior).
         cls.WIRE_CODEC = "dense"
         cls.WIRE_DELTA = False
+        # Fault tolerance: patient backoffs matching the long protocol
+        # timeouts; quorum at reference behavior.
+        cls.RETRY_MAX_ATTEMPTS = 3
+        cls.RETRY_BASE_DELAY = 0.2
+        cls.RETRY_MAX_DELAY = 2.0
+        cls.BREAKER_THRESHOLD = 3
+        cls.BREAKER_PROBE_PERIOD = 15.0
+        cls.ROUND_QUORUM = 1.0
 
     @classmethod
     def set_scale_settings(cls) -> None:
@@ -327,6 +388,18 @@ class Settings:
         # aggregate wherever the peer acknowledged holding it.
         cls.WIRE_CODEC = "quant8+zlib"
         cls.WIRE_DELTA = True
+        # Fault tolerance: only one retry — backoff sleeps run on
+        # contended sender threads (gossiper/heartbeater share the GIL
+        # with 1000 in-process nodes), and the breaker caps what a dead
+        # hub can cost regardless. Quorum stays 1.0: the stall exit
+        # (AGGREGATION_STALL above) already handles absent peers and —
+        # unlike an eager quorum — waits for intake to go quiet first.
+        cls.RETRY_MAX_ATTEMPTS = 2
+        cls.RETRY_BASE_DELAY = 0.1
+        cls.RETRY_MAX_DELAY = 1.0
+        cls.BREAKER_THRESHOLD = 3
+        cls.BREAKER_PROBE_PERIOD = 30.0
+        cls.ROUND_QUORUM = 1.0
 
     @classmethod
     def snapshot(cls) -> dict[str, Any]:

@@ -189,9 +189,13 @@ class VoteTrainSetStage(Stage):
         )
 
         # Tally once all live candidates voted or VOTE_TIMEOUT
-        # (reference :109-171).
-        deadline = time.time() + Settings.VOTE_TIMEOUT
-        while time.time() < deadline:
+        # (reference :109-171). Monotonic clock, like every round
+        # deadline: an NTP step mid-vote must not stretch or collapse
+        # the window (the aggregator's stall clock moved first;
+        # mixing clocks made a skewed host tally while still waiting
+        # on the other).
+        deadline = time.monotonic() + Settings.VOTE_TIMEOUT
+        while time.monotonic() < deadline:
             if check_early_stop(node):
                 return None
             with st.train_set_votes_lock:
@@ -238,9 +242,11 @@ def _await_round_result(
     poll until the round's full model arrives (``"full_model"``), an
     optional extra condition holds (``"done"`` — e.g. local aggregation
     coverage), early stop (``"early_stop"``), or ``deadline``
-    (``"timeout"``). FullModelCommand sets ``aggregated_model_event``."""
+    (``"timeout"``). ``deadline`` is a ``time.monotonic()`` instant —
+    wall-clock steps must not stretch or collapse round waits.
+    FullModelCommand sets ``aggregated_model_event``."""
     st = node.state
-    while time.time() < deadline:
+    while time.monotonic() < deadline:
         if check_early_stop(node):
             return "early_stop"
         if st.round is not None and st.last_full_model_round >= st.round:
@@ -308,22 +314,32 @@ class TrainStage(Stage):
 
         # Gossip partial aggregates to train-set peers still missing
         # contributors (reference :119-176; create_connection=True fully
-        # connects the train set).
-        full = set(st.train_set)
+        # connects the train set). Coverage targets are computed over
+        # the LIVE view of the train set: a member the heartbeater has
+        # evicted mid-round can neither report coverage nor receive
+        # pushes, and chasing it would pin the exchange until the
+        # static-status exit every time a trainer crashes. With no
+        # faults the live view IS the train set (identical behavior).
+
+        def live_train_set() -> set[str]:
+            alive = set(node.communication.get_neighbors()) | {node.addr}
+            return {n for n in st.train_set if n in alive}
 
         def early_stop() -> bool:
             if check_early_stop(node):
                 return True
-            # Everyone (including us) covers the full train set.
+            # Every live member (including us) covers the live set.
+            live = live_train_set()
             agg = st.get_models_aggregated()
-            return all(set(agg.get(n, [])) >= full for n in st.train_set)
+            return all(set(agg.get(n, [])) >= live for n in live)
 
         def candidates() -> list[str]:
             agg = st.get_models_aggregated()
+            live = live_train_set()
             return [
                 n
-                for n in st.train_set
-                if n != node.addr and not set(agg.get(n, [])) >= full
+                for n in live
+                if n != node.addr and not set(agg.get(n, [])) >= live
             ]
 
         # Partial-aggregate encodes are cached per (aggregator state,
@@ -380,10 +396,42 @@ class TrainStage(Stage):
         # full model already arrived (FullModelCommand sets
         # last_full_model_round), the round is decided — adopt it
         # instead of burning the whole aggregation timeout.
-        deadline = time.time() + Settings.AGGREGATION_TIMEOUT
+        deadline = time.monotonic() + Settings.AGGREGATION_TIMEOUT
+
+        # Round degradation bookkeeping: first-seen-missing time per
+        # train-set member. A member must stay OUT of the live view for
+        # a full further HEARTBEAT_TIMEOUT beyond its eviction before
+        # the round gives up on it — eviction alone is one stale-beat
+        # observation, and a beat delayed by CPU contention (a peer's
+        # jit compile stalls its heartbeater) would otherwise shrink
+        # the round on a node that is alive and about to contribute,
+        # making fault-free results timing-dependent.
+        dead_since: dict[str, float] = {}
+
+        def confirmed_dead() -> list[str]:
+            now = time.monotonic()
+            live = live_train_set()
+            for member in st.train_set:
+                if member in live:
+                    dead_since.pop(member, None)
+                else:
+                    dead_since.setdefault(member, now)
+            return [
+                m
+                for m, t0 in dead_since.items()
+                if now - t0 >= Settings.HEARTBEAT_TIMEOUT
+            ]
 
         def coverage_done() -> bool:
             if not node.aggregator.is_open():
+                return True
+            # Round degradation: heartbeat loss evicted a train-set
+            # member mid-round — shrink the expected contributor set to
+            # the live members (Settings.ROUND_QUORUM then decides how
+            # much of it must report). A crashed trainer no longer
+            # costs every peer the full AGGREGATION_TIMEOUT.
+            dead = confirmed_dead()
+            if dead and node.aggregator.remove_dead_nodes(dead):
                 return True
             # Stall exit (scale profile): intake has gone quiet with
             # contributions held — an elected peer is absent; proceed
@@ -411,7 +459,7 @@ class TrainStage(Stage):
                 remaining = (
                     0.0
                     if (status == "done" and node.aggregator.is_open())
-                    else max(0.0, deadline - time.time())
+                    else max(0.0, deadline - time.monotonic())
                 )
                 agg_model = node.aggregator.wait_and_get_aggregation(
                     timeout=remaining
@@ -499,7 +547,7 @@ class WaitAggregatedModelsStage(Stage):
     @staticmethod
     def execute(node: "Node") -> Optional[Type[Stage]]:
         st = node.state
-        deadline = time.time() + Settings.AGGREGATION_TIMEOUT
+        deadline = time.monotonic() + Settings.AGGREGATION_TIMEOUT
         status = _await_round_result(node, deadline)
         if status == "early_stop":
             return None
