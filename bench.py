@@ -129,6 +129,229 @@ def _round_flops_estimate(fed_factory, input_shape, batch_shape, n_nodes,
     return f1 * n_nodes * n_batches * epochs
 
 
+def _serde_tier(extra: dict, cnn_host_params) -> None:
+    """Zero-copy model plane tier. Three reports:
+
+    - extra.serde: v1 (legacy dense msgpack) vs v3 (pooled header +
+      contiguous payload, zero-copy decode views) encode/decode
+      throughput in GB/s of dense payload, on the digits MLP (the
+      protocol e2e model) and the flagship CNN params, plus the ≥2x
+      round-trip acceptance boolean.
+    - extra.serde_agg_peak: aggregation peak-RSS DELTA (beyond holding
+      the contributions themselves) for a 2- vs 64-contributor FedAvg
+      round, measured in a fresh subprocess each (ru_maxrss is a
+      high-water mark) — the streaming donated accumulator keeps it
+      O(1 model), flat in N.
+    - extra.serde_inproc_ab: a seeded 4-node in-memory digits
+      federation run with the byte path and again with
+      Settings.INPROC_ZERO_COPY (model payloads handed across by
+      reference): rounds/sec both ways and the final-loss rel diff
+      (must be ~0 — the ref path is exact).
+
+    The sim1000 tier above is unchanged by the zero-copy plane (it
+    times the vmapped round program, no serialization in the loop);
+    its number riding in the same BENCH line is the no-regression
+    check.
+    """
+    import json as _json
+    import os as _os
+    import subprocess
+    import sys as _sys
+
+    import numpy as np
+
+    from tpfl.learning import serialization as ser
+
+    try:
+        rng = np.random.default_rng(0)
+        # The digits example's model: the zoo MLP defaults ((256, 128)
+        # hidden) on 28x28 input — ~920 KB of payload, what an actual
+        # digits-federation gossip push moves.
+        digits_params = {
+            "dense1": {
+                "kernel": rng.normal(size=(784, 256)).astype(np.float32),
+                "bias": np.zeros(256, np.float32),
+            },
+            "dense2": {
+                "kernel": rng.normal(size=(256, 128)).astype(np.float32),
+                "bias": np.zeros(128, np.float32),
+            },
+            "dense3": {
+                "kernel": rng.normal(size=(128, 10)).astype(np.float32),
+                "bias": np.zeros(10, np.float32),
+            },
+        }
+
+        def _tp(fn, n=5):
+            fn()  # warm
+            best = float("inf")
+            for _ in range(n):
+                t0 = time.perf_counter()
+                fn()
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        report = {}
+        for name, tree in (("digits_mlp", digits_params), ("cnn", cnn_host_params)):
+            v1 = ser.encode_model_payload(tree, ["b"], 1, {})
+            v3 = ser.encode_model_payload_v3(tree, ["b"], 1, {})
+            gb = len(v1) / 1e9
+            te1 = _tp(lambda: ser.encode_model_payload(tree, ["b"], 1, {}))
+            te3 = _tp(lambda: ser.encode_model_payload_v3(tree, ["b"], 1, {}))
+            td1 = _tp(lambda: ser.decode_model_payload(v1))
+            td3 = _tp(lambda: ser.decode_model_payload(v3))
+            report[name] = {
+                "payload_bytes_v1": len(v1),
+                "payload_bytes_v3": len(v3),
+                "encode_v1_GBps": round(gb / te1, 3),
+                "encode_v3_GBps": round(gb / te3, 3),
+                "decode_v1_GBps": round(gb / td1, 3),
+                "decode_v3_GBps": round(gb / td3, 3),
+                "roundtrip_speedup_v3": round((te1 + td1) / (te3 + td3), 2),
+                "ge_2x_roundtrip": bool((te1 + td1) / (te3 + td3) >= 2.0),
+            }
+        extra["serde"] = report
+
+        # Aggregation peak memory vs contributor count: fresh
+        # subprocess per N (ru_maxrss is monotonic within a process).
+        child = r"""
+import os, resource, json, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax, jax.numpy as jnp, numpy as np
+from tpfl.learning.model import TpflModel
+from tpfl.learning.aggregators import FedAvg
+N = int(sys.argv[1]); P = 4_000_000  # 16 MB f32 model
+rng = np.random.default_rng(0)
+def mk(i):
+    return TpflModel(params={"w": jnp.asarray(rng.normal(size=(P,)), jnp.float32)},
+                     num_samples=1, contributors=[f"n{i}"])
+models = [mk(i) for i in range(N)]
+jax.block_until_ready([m.get_parameters()["w"] for m in models])
+# Warm the jitted fold (compile + steady accumulator churn) BEFORE the
+# baseline snapshot: ru_maxrss is a high-water mark, so the measured
+# delta is the MARGINAL memory the N-contributor aggregation adds — an
+# O(N x model) stack still shows (it materializes per call); the
+# streaming donated fold does not.
+warm = FedAvg("warm").aggregate([mk(900), mk(901)])
+jax.block_until_ready(jax.tree_util.tree_leaves(warm.get_parameters()))
+del warm
+base = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+out = FedAvg("bench").aggregate(models)
+jax.block_until_ready(jax.tree_util.tree_leaves(out.get_parameters()))
+peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+print(json.dumps({"agg_peak_delta_kb": int(peak - base)}))
+"""
+        peaks = {}
+        for n_contrib in (2, 64):
+            proc = subprocess.run(
+                [_sys.executable, "-c", child, str(n_contrib)],
+                capture_output=True,
+                text=True,
+                timeout=300,
+                cwd=_os.path.dirname(_os.path.abspath(__file__)),
+            )
+            peaks[n_contrib] = _json.loads(proc.stdout.strip().splitlines()[-1])[
+                "agg_peak_delta_kb"
+            ]
+        # O(1) check: marginal growth for 64 contributors within 1.5x
+        # of 2 contributors (+32 MB allocator-noise grace — two model
+        # buffers, far below the ~1 GB a 64-wide stack materializes).
+        flat = peaks[64] <= 1.5 * peaks[2] + 32768
+        extra["serde_agg_peak"] = {
+            "model_bytes": 16_000_000,
+            "peak_delta_kb_n2": peaks[2],
+            "peak_delta_kb_n64": peaks[64],
+            "o1_flat_within_1.5x": bool(flat),
+        }
+    except Exception as e:
+        extra["serde_error"] = str(e)[:200]
+
+    # In-process zero-copy A/B: byte path vs by-reference handoff.
+    try:
+        from tpfl.settings import Settings
+
+        snap = Settings.snapshot()
+        try:
+            from tpfl.management.logger import logger as _logger
+
+            Settings.set_test_settings()
+            Settings.LOG_LEVEL = "ERROR"
+            _logger.set_level("ERROR")
+            Settings.ELECTION = "hash"
+            Settings.SEED = 4321
+
+            def run(zero_copy: bool) -> dict:
+                from tpfl.learning.dataset import (
+                    RandomIIDPartitionStrategy,
+                    synthetic_mnist,
+                )
+                from tpfl.models import create_model
+                from tpfl.node import Node
+                from tpfl.utils import wait_convergence, wait_to_finish
+
+                Settings.INPROC_ZERO_COPY = zero_copy
+                Settings.AGG_STREAM_EAGER = zero_copy
+                n, rounds = 4, 6
+                ds = synthetic_mnist(n_train=200 * n, n_test=60, seed=0, noise=0.8)
+                parts = ds.generate_partitions(
+                    n, RandomIIDPartitionStrategy, seed=1
+                )
+                nodes = [
+                    Node(
+                        create_model("mlp", (28, 28), seed=7, hidden_sizes=(32,)),
+                        parts[i],
+                        # SAME addresses in both runs: learner shuffle
+                        # seeds derive from (Settings.SEED, addr), and
+                        # differing addrs would give the two runs
+                        # different data orders and an incomparable
+                        # loss (the chaos tier pins its addrs for the
+                        # same reason). Runs are sequential, so no
+                        # registry collision.
+                        addr=f"serde-{i}",
+                        learning_rate=0.05,
+                        batch_size=32,
+                    )
+                    for i in range(n)
+                ]
+                for nd in nodes:
+                    nd.start()
+                try:
+                    for nd in nodes[1:]:
+                        nodes[0].connect(nd.addr)
+                    wait_convergence(nodes, n - 1, only_direct=False, wait=10)
+                    t0 = time.monotonic()
+                    nodes[0].set_start_learning(rounds=rounds, epochs=1)
+                    wait_to_finish(nodes, timeout=240)
+                    elapsed = time.monotonic() - t0
+                    loss = float(
+                        nodes[0].learner.evaluate().get("test_loss", float("nan"))
+                    )
+                    return {
+                        "rounds_per_sec": round(rounds / elapsed, 3),
+                        "final_loss": round(loss, 4),
+                    }
+                finally:
+                    for nd in nodes:
+                        nd.stop()
+
+            by = run(False)
+            zc = run(True)
+            rel = abs(zc["final_loss"] - by["final_loss"]) / max(
+                abs(by["final_loss"]), 1e-9
+            )
+            extra["serde_inproc_ab"] = {
+                "seed": 4321,
+                "byte_path": by,
+                "zero_copy": zc,
+                "loss_rel_diff": round(rel, 4),
+                "loss_within_1pct": bool(rel <= 0.01),
+            }
+        finally:
+            Settings.restore(snap)
+    except Exception as e:
+        extra["serde_inproc_error"] = str(e)[:200]
+
+
 def _chaos_tier(extra: dict) -> None:
     """Chaos tier (communication/faults.py). Two reports:
 
@@ -925,6 +1148,11 @@ def main() -> None:
         }
     except Exception as e:
         extra["wire_codec_error"] = str(e)[:200]
+
+    # Serde tier: v1-vs-v3 encode/decode GB/s, aggregation peak RSS vs
+    # contributor count, in-process zero-copy A/B
+    # (extra.serde / extra.serde_agg_peak / extra.serde_inproc_ab).
+    _serde_tier(extra, jax.tree_util.tree_map(np.asarray, params))
 
     # Chaos tier: deterministic fault accounting + live faulted A/B
     # (extra.chaos_determinism / extra.chaos_ab).

@@ -431,3 +431,137 @@ def test_round_quorum_closes_early():
         assert not agg.is_open()  # 3/4 meets quorum
     finally:
         Settings.ROUND_QUORUM = snap
+
+
+# --- streaming accumulate/finalize (O(1)-peak on-device reduction) ---
+
+
+def test_fedavg_streaming_fold_matches_reference_math():
+    """The donated running-accumulator fold must reproduce the stacked
+    weighted mean (same inputs, same result, any fold order)."""
+    agg = FedAvg("t")
+    models = [mk_model(1, 1, ["a"]), mk_model(3, 2, ["b"]), mk_model(5, 3, ["c"])]
+    expected = (1 * 1 + 3 * 2 + 5 * 3) / 6.0
+    out = agg.aggregate(models)
+    np.testing.assert_allclose(
+        np.asarray(out.get_parameters()["w"]), expected, rtol=1e-6
+    )
+    # explicit streaming API, reversed order
+    st = agg.acc_init(models[0])
+    for m in reversed(models):
+        st = agg.accumulate(st, m)
+    out2 = agg.finalize(st)
+    np.testing.assert_allclose(
+        np.asarray(out2.get_parameters()["w"]), expected, rtol=1e-6
+    )
+    assert out2.get_contributors() == ["a", "b", "c"]
+    assert out2.get_num_samples() == 6
+
+
+def test_eager_stream_reduces_on_arrival_and_closes_with_finalize():
+    """Settings.AGG_STREAM_EAGER: add_model folds into the on-device
+    accumulator as contributions arrive; wait_and_get_aggregation is a
+    single finalize (no batch fold of held models)."""
+    from tpfl.settings import Settings
+
+    Settings.AGG_STREAM_EAGER = True
+    agg = FedAvg("t")
+    agg.set_nodes_to_aggregate(["a", "b"])
+    agg.add_model(mk_model(2, 1, ["a"]))
+    assert agg._stream is not None and agg._stream.count == 1
+    agg.add_model(mk_model(4, 1, ["b"]))
+    out = agg.wait_and_get_aggregation(timeout=5)
+    np.testing.assert_allclose(np.asarray(out.get_parameters()["w"]), 3.0)
+    assert agg._stream is None  # consumed exactly once (donated buffers)
+    agg.clear()
+
+
+def test_eager_stream_rejected_models_not_folded():
+    from tpfl.settings import Settings
+
+    Settings.AGG_STREAM_EAGER = True
+    agg = FedAvg("t")
+    agg.set_nodes_to_aggregate(["a", "b"])
+    agg.add_model(mk_model(2, 1, ["a"]))
+    agg.add_model(mk_model(999, 1, ["zz"]))  # not in train set: rejected
+    agg.add_model(mk_model(999, 1, ["a"]))  # duplicate: rejected
+    agg.add_model(mk_model(4, 1, ["b"]))
+    out = agg.wait_and_get_aggregation(timeout=5)
+    np.testing.assert_allclose(np.asarray(out.get_parameters()["w"]), 3.0)
+    agg.clear()
+
+
+def test_fedprox_ships_mu_through_eager_finalize():
+    from tpfl.settings import Settings
+
+    Settings.AGG_STREAM_EAGER = True
+    agg = FedProx("t", proximal_mu=0.123)
+    agg.set_nodes_to_aggregate(["a", "b"])
+    agg.add_model(mk_model(1, 1, ["a"]))
+    agg.add_model(mk_model(3, 1, ["b"]))
+    out = agg.wait_and_get_aggregation(timeout=5)
+    assert out.get_info("fedprox") == {"mu": 0.123}
+    agg.clear()
+
+
+def test_scaffold_streaming_matches_batch():
+    delta = {
+        "w": jnp.full((2, 2), 1.0, jnp.float32),
+        "b": jnp.full((2,), 1.0, jnp.float32),
+    }
+    mk = lambda v, c: mk_model(  # noqa: E731
+        v, 10, [c], extra={"scaffold": {"delta_y_i": delta, "delta_c_i": delta}}
+    )
+    batch = Scaffold("t")
+    out_b = batch.aggregate([mk(2, "a"), mk(4, "b")])
+    stream = Scaffold("t")
+    st = stream.acc_init(mk(2, "a"))
+    st = stream.accumulate(st, mk(2, "a"))
+    st = stream.accumulate(st, mk(4, "b"))
+    out_s = stream.finalize(st)
+    np.testing.assert_allclose(
+        np.asarray(out_b.get_parameters()["w"]),
+        np.asarray(out_s.get_parameters()["w"]),
+        rtol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_b.get_info("scaffold")["global_c"]["w"]),
+        np.asarray(out_s.get_info("scaffold")["global_c"]["w"]),
+        rtol=1e-6,
+    )
+
+
+def test_fedmedian_reservoir_is_bounded():
+    from tpfl.settings import Settings
+
+    Settings.AGG_MEDIAN_RESERVOIR = 4
+    agg = FedMedian("t")
+    models = [mk_model(i, 1, [f"n{i}"]) for i in range(10)]
+    st = agg.acc_init(models[0])
+    for m in models:
+        st = agg.accumulate(st, m)
+    assert len(st.extra["reservoir"]) == 4  # bounded past the cap
+    out = agg.finalize(st)
+    assert np.isfinite(np.asarray(out.get_parameters()["w"])).all()
+    # below the cap the median is EXACT
+    Settings.AGG_MEDIAN_RESERVOIR = 64
+    exact = agg.aggregate(
+        [mk_model(0, 1, ["a"]), mk_model(1, 1, ["b"]), mk_model(100, 1, ["c"])]
+    )
+    np.testing.assert_allclose(np.asarray(exact.get_parameters()["w"]), 1.0)
+
+
+def test_eager_stream_fold_error_falls_back_to_batch():
+    """A mid-round fold failure (e.g. SCAFFOLD info missing at arrival)
+    must not poison the round: the eager stream dies and round close
+    batch-folds the held models (raising the aggregator's own error)."""
+    from tpfl.settings import Settings
+
+    Settings.AGG_STREAM_EAGER = True
+    agg = Scaffold("t")
+    agg.set_nodes_to_aggregate(["a"])
+    agg.add_model(mk_model(1, 5, ["a"]))  # trained but NO scaffold info
+    assert agg._stream is None and agg._stream_dead
+    with pytest.raises(ValueError, match="delta_y_i"):
+        agg.wait_and_get_aggregation(timeout=5)
+    agg.clear()

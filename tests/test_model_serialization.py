@@ -157,3 +157,179 @@ def test_build_copy_from_wire_bytes_restores_dtype():
     copy = model.build_copy(params=wire)
     for leaf in jax.tree_util.tree_leaves(copy.get_parameters()):
         assert leaf.dtype == jnp.float32, leaf.dtype
+
+
+# --- v3 zero-copy layout (pooled serialization) ---
+
+
+def test_v3_roundtrip_preserves_dtype_shape_metadata():
+    params = make_params()
+    blob = serialization.encode_model_payload_v3(
+        params, ["node-a", "node-b"], 123, {"scaffold": {"x": np.arange(3)}}
+    )
+    assert blob[:1] == b"\x03"
+    p, contribs, n, info = serialization.decode_model_payload(blob)
+    assert contribs == ["node-a", "node-b"]
+    assert n == 123
+    np.testing.assert_array_equal(info["scaffold"]["x"], np.arange(3))
+    np.testing.assert_array_equal(
+        np.asarray(params["dense1"]["kernel"]), p["dense1"]["kernel"]
+    )
+    got = np.asarray(p["dense2"]["kernel"])
+    assert str(got.dtype) == "bfloat16"
+
+
+def test_v3_decode_views_are_zero_copy_and_read_only():
+    params = make_params()
+    blob = serialization.encode_model_payload_v3(params, ["a"], 1, {})
+    p, *_ = serialization.decode_model_payload(blob)
+    leaf = p["dense1"]["kernel"]
+    assert not leaf.flags.writeable
+    with pytest.raises(ValueError):
+        leaf[0, 0] = 9.0
+    # zero-copy: the view's memory IS the payload bytes
+    assert leaf.base is not None
+
+
+@pytest.mark.parametrize("version", ["v1", "v3"])
+def test_strided_leaf_roundtrip(version):
+    """Regression: transposed / sliced (non-C-contiguous) leaves must
+    encode without crashing, copying only when the layout demands it."""
+    base = np.arange(24, dtype=np.float32).reshape(4, 6)
+    params = {
+        "t": base.T,            # transposed view
+        "s": base[:, ::2],      # strided slice
+        "c": base,              # contiguous control
+    }
+    enc = (
+        serialization.encode_model_payload
+        if version == "v1"
+        else serialization.encode_model_payload_v3
+    )
+    blob = enc(params, ["a"], 1, {})
+    p, *_ = serialization.decode_model_payload(blob)
+    np.testing.assert_array_equal(p["t"], base.T)
+    np.testing.assert_array_equal(p["s"], base[:, ::2])
+    np.testing.assert_array_equal(p["c"], base)
+
+
+@pytest.mark.parametrize("version", ["v1", "v3"])
+@pytest.mark.parametrize(
+    "shape", [(), (0,), (0, 3), (1,)], ids=["0d", "empty", "empty2d", "one"]
+)
+def test_zero_size_and_scalar_leaves_roundtrip(version, shape):
+    """Regression: shape [] (0-d) and shape [0] (zero-size) leaves must
+    take one consistent decode path across wire versions."""
+    arr = np.full(shape, 2.5, np.float32)
+    enc = (
+        serialization.encode_model_payload
+        if version == "v1"
+        else serialization.encode_model_payload_v3
+    )
+    blob = enc({"x": arr}, ["a"], 1, {})
+    p, *_ = serialization.decode_model_payload(blob)
+    assert p["x"].shape == shape
+    assert p["x"].dtype == np.float32
+    np.testing.assert_array_equal(p["x"], arr)
+
+
+def test_v3_payload_version_detection():
+    from tpfl.learning import compression
+
+    params = make_params()
+    v1 = serialization.encode_model_payload(params, ["a"], 1, {})
+    v3 = serialization.encode_model_payload_v3(params, ["a"], 1, {})
+    assert compression.payload_version(v1) == 1
+    assert compression.payload_version(v3) == 3
+    assert not compression.payload_is_delta(v3)
+
+
+def test_v3_encode_is_deterministic_across_pool_reuse():
+    """Alignment-gap bytes must be zeroed: payload bytes are hashed
+    (election beacon) and compared (gossip byte caches), so a reused
+    pool buffer's stale content must never leak into them."""
+    params = make_params()
+    blobs = {
+        serialization.encode_model_payload_v3(params, ["a"], 1, {})
+        for _ in range(4)
+    }
+    assert len(blobs) == 1
+
+
+def test_truncated_v3_payload_does_not_grow_pool():
+    """Decode-error paths must not leak pooled buffers: pooled leases
+    are context-managed, and decode never holds one."""
+    from tpfl.learning.bufferpool import BufferPool
+
+    pool = BufferPool(max_buffers=4)
+    params = make_params()
+    blob = serialization.encode_model_payload_v3(params, ["a"], 1, {}, pool=pool)
+    assert pool.outstanding == 0
+    for cut in (0, 3, 4, 12, len(blob) // 2, len(blob) - 1):
+        with pytest.raises(DecodingParamsError):
+            serialization.decode_model_payload(blob[:cut])
+    # corrupt header length field
+    bad = bytearray(blob)
+    bad[1:5] = (2**31).to_bytes(4, "little")
+    with pytest.raises(DecodingParamsError):
+        serialization.decode_model_payload(bytes(bad))
+    for _ in range(8):
+        serialization.encode_model_payload_v3(params, ["a"], 1, {}, pool=pool)
+    assert pool.outstanding == 0
+    assert pool.pooled_buffers <= 4
+
+
+def test_buffer_pool_reuse_and_error_paths():
+    import gc
+
+    from tpfl.learning.bufferpool import BufferPool
+
+    pool = BufferPool(max_buffers=2, max_bytes=1 << 20)
+    with pool.acquire(1000) as b:
+        mv = b.view()
+        assert len(mv) == 1000
+        mv[:4] = b"abcd"
+    assert pool.outstanding == 0 and pool.pooled_buffers == 1
+    # same-size re-acquire hits the pooled buffer
+    with pool.acquire(900):
+        pass
+    assert pool.hits == 1
+    # exception inside the context manager still releases
+    with pytest.raises(RuntimeError):
+        with pool.acquire(100):
+            raise RuntimeError("boom")
+    assert pool.outstanding == 0
+    # forgotten release: the GC finalizer backstop returns the buffer
+    lease = pool.acquire(100)
+    del lease
+    gc.collect()
+    assert pool.outstanding == 0
+    # use-after-release is an error, not silent corruption
+    lease = pool.acquire(100)
+    lease.release()
+    with pytest.raises(ValueError):
+        lease.view()
+    # bounded: max_buffers respected
+    leases = [pool.acquire(100) for _ in range(5)]
+    for l in leases:
+        l.release()
+    assert pool.pooled_buffers <= 2
+
+
+def test_model_encode_respects_wire_format_setting():
+    from tpfl.settings import Settings
+
+    m = TpflModel(params=make_params())
+    m.set_contribution(["a"], 3)
+    assert m.encode_parameters()[:1] == b"\x03"  # v3 default
+    prev = Settings.WIRE_FORMAT
+    Settings.WIRE_FORMAT = 1
+    try:
+        legacy = m.encode_parameters()
+        assert legacy[:1] != b"\x03"
+        # old-format bytes decode on a v3-default peer
+        m2 = TpflModel(params=make_params(1))
+        m2.set_parameters(legacy)
+        assert m2.get_contributors() == ["a"]
+    finally:
+        Settings.WIRE_FORMAT = prev

@@ -1,0 +1,248 @@
+"""Zero-copy model plane tests: by-reference in-memory transport
+(``Settings.INPROC_ZERO_COPY``), aliasing/immutability guarantees, the
+``model_payload`` transport seam, and the copy-discipline lint.
+
+The load-bearing property: handing a model across by reference must be
+indistinguishable from the byte path EXCEPT for speed — in particular a
+receiver mutating its copy (attack injection, further training, info
+updates) must never reach back into the sender's model, under BOTH
+settings of the flag.
+"""
+
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpfl.communication import InMemoryCommunicationProtocol
+from tpfl.communication.grpc_transport import GrpcCommunicationProtocol
+from tpfl.communication.memory import clear_registry
+from tpfl.learning import serialization
+from tpfl.learning.model import TpflModel
+from tpfl.settings import Settings
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    clear_registry()
+    yield
+    clear_registry()
+
+
+def make_params(seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "w": jnp.asarray(rng.normal(size=(4, 8)), jnp.float32),
+        "b": jnp.zeros((8,), jnp.float32),
+    }
+
+
+# --- InprocModelRef semantics ---
+
+
+def test_ref_shares_jax_leaves_without_copy():
+    m = TpflModel(params=make_params(), num_samples=5, contributors=["a"])
+    ref = m.as_ref()
+    recv = TpflModel(params=make_params(1))
+    recv.set_parameters(ref)
+    # jax arrays are immutable: same-dtype asarray is the SAME object —
+    # the handoff moved zero bytes.
+    assert recv.get_parameters()["w"] is m.get_parameters()["w"]
+    assert recv.get_contributors() == ["a"]
+    assert recv.get_num_samples() == 5
+
+
+def test_ref_freezes_numpy_leaves():
+    host = {"w": np.ones((3, 3), np.float32)}
+    m = TpflModel(params=None)
+    m._params = host  # host-numpy model (no device upload)
+    m.set_contribution(["n"], 1)
+    ref = m.as_ref()
+    with pytest.raises(ValueError):
+        ref.params["w"][0, 0] = 9.0
+    # ...and the freeze is a view, not a copy
+    assert ref.params["w"].base is host["w"]
+
+
+def test_ref_metadata_is_copied_not_shared():
+    m = TpflModel(
+        params=make_params(), num_samples=3, contributors=["a"],
+        additional_info={"k": 1},
+    )
+    ref = m.as_ref()
+    recv = TpflModel(params=make_params(1))
+    recv.set_parameters(ref)
+    recv.get_contributors().append("evil")
+    recv.add_info("k", 2)
+    assert m.get_contributors() == ["a"]
+    assert m.get_info("k") == 1
+
+
+@pytest.mark.parametrize("zero_copy", [False, True])
+def test_receiver_mutation_never_reaches_sender(zero_copy):
+    """The satellite contract: mutate a received model and assert the
+    sender's copy is unaffected under both INPROC_ZERO_COPY settings."""
+    Settings.INPROC_ZERO_COPY = zero_copy
+    proto = InMemoryCommunicationProtocol("zc-sender")
+    sender = TpflModel(params=make_params(), num_samples=2, contributors=["s"])
+    before = np.asarray(sender.get_parameters()["w"]).copy()
+    payload = proto.model_payload(sender)
+    if zero_copy:
+        assert serialization.is_byref(payload)
+    else:
+        assert isinstance(payload, bytes)
+    recv = TpflModel(params=make_params(1))
+    recv.set_parameters(payload)
+    # sign-flip attack on the received model (the harshest in-repo
+    # mutator), plus an in-place numpy attempt on whatever leaked out
+    recv.apply_to_params(lambda x: -x)
+    got = np.asarray(recv.get_parameters()["w"])
+    np.testing.assert_allclose(got, -before, rtol=1e-6)
+    np.testing.assert_array_equal(
+        np.asarray(sender.get_parameters()["w"]), before
+    )
+
+
+# --- the model_payload transport seam ---
+
+
+def test_model_payload_byref_only_on_inproc_transport():
+    m = TpflModel(params=make_params(), num_samples=1, contributors=["a"])
+    mem = InMemoryCommunicationProtocol("zc-mem")
+    grpc = GrpcCommunicationProtocol("127.0.0.1:49999")
+    Settings.INPROC_ZERO_COPY = True
+    assert serialization.is_byref(mem.model_payload(m))
+    # gRPC crosses a process boundary: always bytes, flag irrelevant
+    assert isinstance(grpc.model_payload(m), bytes)
+    Settings.INPROC_ZERO_COPY = False
+    assert isinstance(mem.model_payload(m), bytes)
+
+
+def test_wire_framing_rejects_byref_payload():
+    from tpfl.communication.message import Message
+
+    m = TpflModel(params=make_params(), num_samples=1, contributors=["a"])
+    msg = Message(source="a", cmd="full_model", payload=m.as_ref())
+    assert msg.is_weights
+    with pytest.raises(TypeError):
+        msg.to_bytes()
+
+
+@pytest.mark.parametrize("zero_copy", [False, True])
+def test_inmemory_weights_exchange_e2e(zero_copy):
+    """Two live in-memory protocol nodes exchange a weights message;
+    the receiver's handler decodes via the normal build_copy intake and
+    mutates; the sender's model stays pristine."""
+    Settings.INPROC_ZERO_COPY = zero_copy
+    a, b = InMemoryCommunicationProtocol("zc-a"), InMemoryCommunicationProtocol("zc-b")
+    a.start()
+    b.start()
+    try:
+        a.connect(b.get_address())
+        base = TpflModel(params=make_params(9))
+        received = {}
+        done = threading.Event()
+
+        def handler(source, round, weights, contributors, num_samples):
+            model = base.build_copy(params=weights)
+            model.apply_to_params(lambda x: x * 0.0)  # receiver mutates
+            received["model"] = model
+            received["contributors"] = contributors
+            done.set()
+
+        b.add_command("partial_model", handler)
+        sender = TpflModel(
+            params=make_params(), num_samples=7, contributors=["zc-a"]
+        )
+        before = np.asarray(sender.get_parameters()["w"]).copy()
+        payload = a.model_payload(sender)
+        a.send(
+            b.get_address(),
+            a.build_weights(
+                "partial_model", 0, payload,
+                contributors=sender.get_contributors(), num_samples=7,
+            ),
+        )
+        assert done.wait(timeout=5)
+        assert received["contributors"] == ["zc-a"]
+        got = np.asarray(received["model"].get_parameters()["w"])
+        np.testing.assert_array_equal(got, 0.0)
+        np.testing.assert_array_equal(
+            np.asarray(sender.get_parameters()["w"]), before
+        )
+        assert received["model"].get_num_samples() == 7
+    finally:
+        a.stop()
+        b.stop()
+
+
+# --- copy-discipline lint (CI hook, like the codec/RPC lints) ---
+
+
+def test_wirecheck_copy_lint_passes():
+    import pathlib
+    import sys
+
+    sys.path.insert(
+        0, str(pathlib.Path(__file__).resolve().parent.parent / "tools")
+    )
+    try:
+        import wirecheck
+    finally:
+        sys.path.pop(0)
+    assert wirecheck.check_copies() == [], wirecheck.check_copies()
+
+
+# --- full-federation e2e under zero-copy + eager streaming ---
+
+
+def test_federation_e2e_zero_copy_and_eager_streaming():
+    """A 2-node in-memory federation with the whole fast path on:
+    by-reference payload handoff + eager on-device accumulation. The
+    experiment must run to completion with a model both nodes agree on
+    — the zero-copy plane changes WHERE bytes move, never the math."""
+    from tpfl.learning.dataset import RandomIIDPartitionStrategy, synthetic_mnist
+    from tpfl.models import create_model
+    from tpfl.node import Node
+    from tpfl.utils import wait_convergence, wait_to_finish
+
+    Settings.INPROC_ZERO_COPY = True
+    Settings.AGG_STREAM_EAGER = True
+    n, rounds = 2, 2
+    ds = synthetic_mnist(n_train=200 * n, n_test=40 * n, seed=0, noise=0.4)
+    parts = ds.generate_partitions(n, RandomIIDPartitionStrategy, seed=1)
+    nodes = [
+        Node(
+            create_model("mlp", (28, 28), seed=7, hidden_sizes=(32,)),
+            parts[i],
+            learning_rate=0.1,
+            batch_size=32,
+        )
+        for i in range(n)
+    ]
+    for nd in nodes:
+        nd.start()
+    try:
+        nodes[0].connect(nodes[1].addr)
+        wait_convergence(nodes, n - 1, only_direct=False, wait=10)
+        nodes[0].set_start_learning(rounds=rounds, epochs=1)
+        wait_to_finish(nodes, timeout=120)
+        for nd in nodes:
+            assert nd.state.round is None  # finished cleanly
+        finals = [
+            np.asarray(
+                jnp.concatenate(
+                    [x.ravel() for x in map(
+                        jnp.asarray, nd.learner.get_model().get_parameters_list()
+                    )]
+                )
+            )
+            for nd in nodes
+        ]
+        np.testing.assert_allclose(finals[0], finals[1], rtol=1e-5, atol=1e-6)
+        metrics = nodes[0].learner.evaluate()
+        assert np.isfinite(metrics.get("test_loss", np.nan))
+    finally:
+        for nd in nodes:
+            nd.stop()

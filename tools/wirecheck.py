@@ -1,6 +1,7 @@
 #!/usr/bin/env python
 """Wire-path lint: model payloads must go through the codec registry,
-and outbound RPCs must go through the retrying send path.
+outbound RPCs must go through the retrying send path, and array bytes
+must not be copied outside the serialization layer.
 
 Fails (exit 1) when any file under ``tpfl/`` serializes model payloads
 with raw ``serialization.encode_pytree`` / ``encode_model_payload`` /
@@ -79,6 +80,45 @@ def check(repo_root: "pathlib.Path | None" = None) -> list[str]:
     return violations
 
 
+# --- copy-discipline lint ------------------------------------------------
+
+# The zero-copy model plane routes every leaf-byte extraction through
+# serialization.leaf_bytes (borrowed memoryview, no copy) and every
+# decode through zero-copy frombuffer views. A stray `.tobytes()` or a
+# `frombuffer(...).copy()` outside the two serialization modules
+# reintroduces exactly the per-leaf memcpy the v3 layout removed — and
+# does it silently, since the payload still round-trips.
+COPIES_ALLOWED = {
+    # The serialization layer itself: leaf_bytes' last-resort fallback
+    # and the envelope implementations.
+    "tpfl/learning/serialization.py",
+    "tpfl/learning/compression.py",
+}
+
+COPY_PATTERN = re.compile(
+    r"\.tobytes\s*\(" r"|frombuffer\s*\([^)]*\)\s*\.copy\s*\("
+)
+
+
+def check_copies(repo_root: "pathlib.Path | None" = None) -> list[str]:
+    """Return 'path:line: offending text' for array-byte copies outside
+    the serialization layer (route through serialization.leaf_bytes /
+    the versioned decode views)."""
+    root = repo_root or pathlib.Path(__file__).resolve().parent.parent
+    violations: list[str] = []
+    for path in sorted((root / "tpfl").rglob("*.py")):
+        rel = path.relative_to(root).as_posix()
+        if rel in COPIES_ALLOWED:
+            continue
+        for lineno, line in enumerate(
+            path.read_text(encoding="utf-8").splitlines(), 1
+        ):
+            stripped = line.split("#", 1)[0]
+            if COPY_PATTERN.search(stripped):
+                violations.append(f"{rel}:{lineno}: {line.strip()}")
+    return violations
+
+
 # --- RPC-path lint -------------------------------------------------------
 
 # The only module allowed to touch gRPC stubs/channels.
@@ -142,6 +182,21 @@ def main() -> int:
     else:
         print(
             "wirecheck OK — all model payload paths go through the codec registry"
+        )
+    copy_violations = check_copies()
+    if copy_violations:
+        print(
+            "wirecheck FAILED — array bytes copied outside the "
+            "serialization layer (route through serialization.leaf_bytes "
+            "or the zero-copy decode views):",
+            file=sys.stderr,
+        )
+        for v in copy_violations:
+            print(f"  {v}", file=sys.stderr)
+        rc = 1
+    else:
+        print(
+            "wirecheck OK — no array-byte copies outside the serialization layer"
         )
     rpc_violations = check_rpc()
     if rpc_violations:

@@ -38,6 +38,13 @@ class ThreadedCommunicationProtocol(CommunicationProtocol):
     """Template transport: gossiper + heartbeater threads over a peer
     table, with subclass hooks for the actual wire."""
 
+    # Transport capability: True when sender and receiver share an
+    # address space and model payloads may travel BY REFERENCE
+    # (InprocModelRef) instead of as encoded bytes. Only the in-memory
+    # transport sets it; combined with Settings.INPROC_ZERO_COPY it
+    # turns every weights hop into a pointer handoff.
+    ZERO_COPY_INPROC: bool = False
+
     def __init__(self, addr: str) -> None:
         self._addr = addr
         self._started = False
@@ -187,10 +194,12 @@ class ThreadedCommunicationProtocol(CommunicationProtocol):
         self,
         cmd: str,
         round: int,
-        serialized_model: bytes,
+        serialized_model: "bytes | Any",
         contributors: Optional[list[str]] = None,
         num_samples: int = 0,
     ) -> Message:
+        """``serialized_model``: encoded payload bytes, or — on a
+        zero-copy in-process transport — an ``InprocModelRef``."""
         return Message(
             source=self._addr,
             cmd=cmd,
@@ -199,6 +208,25 @@ class ThreadedCommunicationProtocol(CommunicationProtocol):
             contributors=list(contributors or []),
             num_samples=num_samples,
         )
+
+    def model_payload(self, model: Any, delta_base: Optional[tuple] = None) -> Any:
+        """Encode ``model`` for THIS transport — the one sanctioned
+        payload-producing seam for the weight-gossip paths.
+
+        On a zero-copy in-process transport (``ZERO_COPY_INPROC`` +
+        ``Settings.INPROC_ZERO_COPY``) this skips serialization
+        entirely and hands the parameter pytree across by reference
+        (``TpflModel.as_ref``: frozen leaves, copied metadata —
+        receivers cannot mutate the sender). Everything else gets the
+        normal codec-registry encode (``encode_parameters``), byte-
+        identical to pre-zero-copy behavior. ``delta_base`` requests a
+        residual payload and is ignored on the by-reference path (a ref
+        is already exact and costs nothing)."""
+        if self.ZERO_COPY_INPROC and Settings.INPROC_ZERO_COPY:
+            return model.as_ref()
+        if delta_base is not None:
+            return model.encode_parameters(delta_base=delta_base)
+        return model.encode_parameters()
 
     def send(
         self,

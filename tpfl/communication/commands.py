@@ -166,7 +166,9 @@ class InitModelRequestCommand(NodeCommand):
         if not (live or finished_same_exp):
             return  # nothing to serve
         try:
-            payload = self.node.learner.get_model().encode_parameters()
+            payload = self.node.communication.model_payload(
+                self.node.learner.get_model()
+            )
         except Exception as e:
             logger.debug(st.addr, f"init request from {source} failed: {e}")
             return
@@ -510,9 +512,11 @@ class FullModelCommand(NodeCommand):
                         # whole audience) usually doesn't. Re-encode the
                         # just-adopted full model through the configured
                         # codec (no delta) instead of forwarding bytes
-                        # it will have to nack.
-                        relay_bytes = (
-                            node.learner.get_model().encode_parameters()
+                        # it will have to nack. (By-reference payloads
+                        # are never delta — payload_is_delta is False —
+                        # so zero-copy relays forward the ref verbatim.)
+                        relay_bytes = node.communication.model_payload(
+                            node.learner.get_model()
                         )
                     payload = node.communication.build_weights(
                         FullModelCommand.name,

@@ -36,6 +36,13 @@ def _lookup(addr: str) -> Optional["InMemoryCommunicationProtocol"]:
 
 
 class InMemoryCommunicationProtocol(ThreadedCommunicationProtocol):
+    # Sender and receiver share one address space: under
+    # Settings.INPROC_ZERO_COPY, model payloads travel as
+    # InprocModelRef (frozen pytree by reference — no encode, decode,
+    # or memcpy per hop) through base.model_payload. With the flag off,
+    # behavior is byte-identical to the gRPC transport's payload path.
+    ZERO_COPY_INPROC = True
+
     def __init__(self, addr: Optional[str] = None) -> None:
         super().__init__(addr or f"node-{next(_addr_counter)}")
 

@@ -58,6 +58,16 @@ class Message:
     # --- wire format (used by the gRPC transport) ---
 
     def to_bytes(self) -> bytes:
+        if self.payload is not None and not isinstance(
+            self.payload, (bytes, bytearray, memoryview)
+        ):
+            # An InprocModelRef must never cross a process boundary —
+            # only the in-memory transport (which passes the Message
+            # object itself) may carry one.
+            raise TypeError(
+                f"by-reference payload ({type(self.payload).__name__}) "
+                "cannot be wire-framed; encode it first"
+            )
         return msgpack.packb(
             {
                 "src": self.source,

@@ -163,9 +163,11 @@ def scale(args: argparse.Namespace) -> dict[str, float]:
         import numpy as _np
 
         def model_digest(nd) -> str:
+            from tpfl.learning.serialization import leaf_bytes
+
             h = hashlib.sha256()
             for leaf in nd.learner.get_model().get_parameters_list():
-                h.update(_np.asarray(leaf, _np.float32).tobytes())
+                h.update(leaf_bytes(_np.asarray(leaf, _np.float32)))
             return h.hexdigest()[:12]
 
         tally = Counter(model_digest(nd) for nd in nodes)

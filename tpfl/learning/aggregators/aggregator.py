@@ -19,7 +19,7 @@ from __future__ import annotations
 import math
 import threading
 import time
-from abc import ABC, abstractmethod
+from abc import ABC
 from typing import Any
 
 import jax
@@ -36,25 +36,66 @@ class NoModelsToAggregateError(Exception):
 
 def stack_models(models: list[TpflModel]) -> tuple[Any, jnp.ndarray]:
     """Stack N parameter pytrees along a leading node axis and return the
-    per-model sample counts. The stacked tree is what jitted aggregation
-    math consumes — one fused XLA op per leaf instead of a python loop
-    over layers (reference fedavg.py:41-76)."""
+    per-model sample counts — one fused XLA op per leaf instead of a
+    python loop over layers (reference fedavg.py:41-76).
+
+    Memory note: the stacked tree materializes N x model at once, which
+    is why the mean-style aggregators (FedAvg/FedProx/SCAFFOLD) moved to
+    the O(1)-peak streaming accumulate/finalize API below. This helper
+    remains for the aggregators whose math genuinely needs every
+    contribution at once — Krum's pairwise distances, trimmed mean's
+    per-coordinate sort, FedMedian's (bounded) reservoir."""
     trees = [m.get_parameters() for m in models]
     stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
     weights = jnp.asarray([float(m.get_num_samples()) for m in models])
     return stacked, weights
 
 
+class AggStream:
+    """Running-aggregation state for the streaming accumulate/finalize
+    API: an on-device accumulator (``acc`` — donated through every
+    jitted update, so the reduce is in-place and peak memory is O(1)
+    model regardless of contributor count) plus the Python-side
+    bookkeeping finalize needs (template model for dtypes/build_copy,
+    contributor union, sample total). ``offered`` counts every model
+    handed to ``accumulate`` (including ones a subclass chose to skip,
+    e.g. SCAFFOLD's zero-sample fits); ``count`` counts models actually
+    folded — the round-close consistency check compares ``offered``
+    against the held-model list before trusting the eager state."""
+
+    __slots__ = (
+        "acc", "template", "contributors", "num_samples", "count",
+        "offered", "extra",
+    )
+
+    def __init__(self, template: TpflModel) -> None:
+        self.acc: Any = None
+        self.template = template
+        self.contributors: set[str] = set()
+        self.num_samples = 0
+        self.count = 0
+        self.offered = 0
+        self.extra: dict[str, Any] = {}
+
+
 class Aggregator(ABC):
     """Per-round aggregation state machine, one per node."""
 
     SUPPORTS_PARTIAL_AGGREGATION: bool = False
+    SUPPORTS_STREAMING: bool = False
     REQUIRED_CALLBACKS: list[str] = []
 
     def __init__(self, node_name: str = "unknown") -> None:
         self.node_name = node_name
         self._train_set: list[str] = []
         self._models: list[TpflModel] = []
+        # Eager streaming accumulator (Settings.AGG_STREAM_EAGER):
+        # contributions fold on-device as add_model accepts them, so
+        # the round-close aggregation is one finalize. None until the
+        # first accepted model; dropped on any fold error (the close
+        # falls back to the sorted batch fold).
+        self._stream: "AggStream | None" = None
+        self._stream_dead = False
         # Members dropped by remove_dead_nodes this round — a partial
         # bundling one of them re-admits it (see add_model).
         self._removed_dead: set[str] = set()
@@ -75,9 +116,45 @@ class Aggregator(ABC):
 
     # --- math (subclasses) ---
 
-    @abstractmethod
     def aggregate(self, models: list[TpflModel]) -> TpflModel:
-        """Combine models into one. Pure function of the inputs."""
+        """Combine models into one. Pure function of the inputs.
+
+        Streaming aggregators (``SUPPORTS_STREAMING``) get this for
+        free as a sequential accumulate/finalize fold: peak memory is
+        O(1) model — the donated accumulator plus the one contribution
+        being folded — instead of the O(N x model) ``stack_models``
+        materialization. Non-streaming aggregators (Krum, trimmed
+        mean) override with their all-at-once math."""
+        if not models:
+            raise ValueError("No models to aggregate")
+        if not self.SUPPORTS_STREAMING:
+            raise NotImplementedError(
+                f"{type(self).__name__} must override aggregate() or set "
+                "SUPPORTS_STREAMING and implement acc_init/accumulate/finalize"
+            )
+        state = self.acc_init(models[0])
+        for m in models:
+            state = self.accumulate(state, m)
+        return self.finalize(state)
+
+    # Streaming accumulate/finalize API (SUPPORTS_STREAMING subclasses).
+    # Contract: acc_init builds an empty state from any model's
+    # STRUCTURE (the model is a template, not a contribution);
+    # accumulate folds one model in-place (jitted, donate_argnums on
+    # the accumulator — O(1) peak) and returns the state; finalize
+    # consumes the state exactly once (donated buffers) and returns the
+    # aggregated TpflModel.
+
+    def acc_init(self, template: TpflModel) -> AggStream:
+        raise NotImplementedError
+
+    def accumulate(
+        self, state: AggStream, model: TpflModel, weight: "float | None" = None
+    ) -> AggStream:
+        raise NotImplementedError
+
+    def finalize(self, state: AggStream) -> TpflModel:
+        raise NotImplementedError
 
     def get_required_callbacks(self) -> list[str]:
         return list(self.REQUIRED_CALLBACKS)
@@ -101,6 +178,8 @@ class Aggregator(ABC):
         with self._lock:
             self._train_set = list(nodes)
             self._models = []
+            self._stream = None
+            self._stream_dead = False
             self._removed_dead = set()
             self.version += 1
             self._last_intake = time.monotonic()
@@ -188,6 +267,8 @@ class Aggregator(ABC):
         with self._lock:
             self._train_set = []
             self._models = []
+            self._stream = None
+            self._stream_dead = False
             self._removed_dead = set()
             self.version += 1
         self._finish_aggregation_event.set()
@@ -262,6 +343,32 @@ class Aggregator(ABC):
                 )
                 return []
             self._models.append(model)
+            # Eager on-arrival reduce (Settings.AGG_STREAM_EAGER): fold
+            # the accepted contribution into the on-device accumulator
+            # NOW, so the round-close aggregation is one finalize
+            # instead of an O(N)-fold on the critical tail. The jitted
+            # update dispatches asynchronously — the lock is held only
+            # for the enqueue, not the device work. Any fold error
+            # kills the stream for the round; close falls back to the
+            # batch fold over the held models (which reports the error
+            # through the normal aggregate() path).
+            if (
+                self.SUPPORTS_STREAMING
+                and Settings.AGG_STREAM_EAGER
+                and not self._stream_dead
+            ):
+                try:
+                    if self._stream is None:
+                        self._stream = self.acc_init(model)
+                    self._stream = self.accumulate(self._stream, model)
+                except Exception as e:
+                    logger.debug(
+                        self.node_name,
+                        f"Eager accumulate failed ({e}); will batch-fold "
+                        "at round close",
+                    )
+                    self._stream = None
+                    self._stream_dead = True
             self.version += 1
             self._last_intake = time.monotonic()
             covered |= set(contributors)
@@ -288,10 +395,14 @@ class Aggregator(ABC):
         with self._lock:
             # Canonical order: gossip arrival order is scheduling noise,
             # and float reduction order must not depend on it (seeded
-            # reproducibility, exp_SAVE3.txt:282-332).
+            # reproducibility, exp_SAVE3.txt:282-332). Under
+            # AGG_STREAM_EAGER the arrival-order fold already ran; take
+            # (and consume — donated buffers are single-use) the stream
+            # when it covers exactly the held models.
             models = sorted(
                 self._models, key=lambda m: tuple(sorted(m.get_contributors()))
             )
+            stream, self._stream = self._stream, None
         if not finished:
             missing = self.get_missing_models()
             logger.warning(
@@ -304,6 +415,11 @@ class Aggregator(ABC):
             raise NoModelsToAggregateError(
                 f"({self.node_name}) No models to aggregate"
             )
+        if stream is not None and stream.offered == len(models) and stream.count:
+            # Every held model went through the eager fold: the round's
+            # reduce already happened on-device as partials arrived —
+            # close is a single finalize.
+            return self.finalize(stream)
         return self.aggregate(models)
 
     def get_model(self, except_nodes: list[str] | None = None) -> TpflModel | None:

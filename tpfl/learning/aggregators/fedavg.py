@@ -1,49 +1,116 @@
 """FedAvg — sample-weighted parameter mean (McMahan et al. 2016).
 
 Parity with reference ``p2pfl/learning/aggregators/fedavg.py:29-76``, but
-the math is a single jitted sample-weighted tensor contraction per leaf
-on stacked pytrees — it runs fused on the TPU instead of a python loop of
-numpy adds.
+the math is a streaming on-device reduction: contributions fold into a
+running ``(sum w_i·x_i, sum x_i, sum w_i, n)`` accumulator through a
+jitted update whose accumulator buffers are **donated** —
+the reduce is in-place, peak memory is O(1) model regardless of the
+contributor count, and (under ``Settings.AGG_STREAM_EAGER``) it runs as
+partials arrive instead of at round close. The old
+``stack_models``-then-contract path materialized all N contributions in
+one N x model buffer before a single fused op; at 64+ contributors the
+stack — not the math — was the aggregation's memory and latency cost.
+
+The zero-weight fallback is preserved exactly: all-zero sample counts
+(empty partitions) finalize to the uniform mean (the unweighted sum
+rides along), never NaN.
 """
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 
-from tpfl.learning.aggregators.aggregator import Aggregator, stack_models
+from tpfl.learning.aggregators.aggregator import Aggregator, AggStream
 from tpfl.learning.model import TpflModel
 
 
+def _acc_dtype(x):
+    return jnp.promote_types(x.dtype, jnp.float32)
+
+
 @jax.jit
-def _weighted_mean(stacked, weights):
-    """sum_i w_i * x_i / sum_i w_i along the leading node axis."""
-    total = jnp.sum(weights)
-    # All-zero sample counts (empty partitions) fall back to a uniform
-    # mean instead of poisoning every parameter with NaN.
-    norm = jnp.where(
-        total > 0, weights / jnp.maximum(total, 1.0), 1.0 / weights.shape[0]
+def _acc_first(params, w):
+    """Open the running accumulator with the first contribution (in the
+    promoted accumulation dtype)."""
+    swx = jax.tree_util.tree_map(
+        lambda x: w.astype(_acc_dtype(x)) * x.astype(_acc_dtype(x)), params
     )
+    sx = jax.tree_util.tree_map(lambda x: x.astype(_acc_dtype(x)), params)
+    return swx, sx, w.astype(jnp.float32), jnp.float32(1.0)
 
-    def leaf_mean(x):
-        w = norm.astype(jnp.promote_types(x.dtype, jnp.float32))
-        return jnp.tensordot(w, x.astype(w.dtype), axes=1).astype(x.dtype)
 
-    return jax.tree_util.tree_map(leaf_mean, stacked)
+@partial(jax.jit, donate_argnums=(0,))
+def _acc_update(acc, params, w):
+    """Fold one contribution IN-PLACE (the accumulator is donated: XLA
+    aliases the outputs onto the input buffers, so no new model-sized
+    allocation happens per fold)."""
+    swx, sx, total, n = acc
+    swx = jax.tree_util.tree_map(
+        lambda s, x: s + w.astype(s.dtype) * x.astype(s.dtype), swx, params
+    )
+    sx = jax.tree_util.tree_map(
+        lambda s, x: s + x.astype(s.dtype), sx, params
+    )
+    return swx, sx, total + w, n + 1.0
+
+
+@jax.jit
+def _acc_finalize(acc, template):
+    """Weighted mean (uniform-mean fallback when every weight is zero),
+    cast back to the model's own dtypes. No donation here: half the
+    accumulator (the unweighted sum and the scalars) has no matching
+    output to alias, and XLA would warn every round; the O(1)-peak
+    property comes from _acc_update's donation."""
+    swx, sx, total, n = acc
+
+    def leaf(s_wx, s_x, t):
+        mean = jnp.where(
+            total > 0,
+            s_wx / jnp.maximum(total, 1.0),
+            s_x / jnp.maximum(n, 1.0),
+        )
+        return mean.astype(t.dtype)
+
+    return jax.tree_util.tree_map(leaf, swx, sx, template)
 
 
 class FedAvg(Aggregator):
-    """Weighted average of models (partial aggregation supported)."""
+    """Weighted average of models (partial aggregation supported),
+    computed as a donated streaming reduction."""
 
     SUPPORTS_PARTIAL_AGGREGATION = True
+    SUPPORTS_STREAMING = True
 
-    def aggregate(self, models: list[TpflModel]) -> TpflModel:
-        if not models:
+    def acc_init(self, template: TpflModel) -> AggStream:
+        return AggStream(template)
+
+    def accumulate(
+        self, state: AggStream, model: TpflModel, weight: "float | None" = None
+    ) -> AggStream:
+        w = jnp.float32(
+            model.get_num_samples() if weight is None else weight
+        )
+        params = model.get_parameters()
+        if state.acc is None:
+            state.acc = _acc_first(params, w)
+        else:
+            state.acc = _acc_update(state.acc, params, w)
+        state.contributors.update(model.get_contributors())
+        state.num_samples += model.get_num_samples()
+        state.count += 1
+        state.offered += 1
+        return state
+
+    def finalize(self, state: AggStream) -> TpflModel:
+        if state.acc is None:
             raise ValueError("No models to aggregate")
-        stacked, weights = stack_models(models)
-        avg = _weighted_mean(stacked, weights)
-        contributors = sorted({c for m in models for c in m.get_contributors()})
-        total = int(sum(m.get_num_samples() for m in models))
-        return models[0].build_copy(
-            params=avg, contributors=contributors, num_samples=total
+        avg = _acc_finalize(state.acc, state.template.get_parameters())
+        state.acc = None  # donated — single use
+        return state.template.build_copy(
+            params=avg,
+            contributors=sorted(state.contributors),
+            num_samples=int(state.num_samples),
         )

@@ -2,18 +2,30 @@
 
 The reference declares this aggregator but raises ``NotImplementedError``
 (``p2pfl/learning/aggregators/fedmedian.py:47``); tpfl implements it
-fully as a jitted per-leaf median over the stacked node axis. The median
-is robust to a minority of byzantine contributions (pairs with the
-fork's sign-flip / additive-noise attacks).
+fully as a jitted per-leaf median. The median is robust to a minority of
+byzantine contributions (pairs with the fork's sign-flip /
+additive-noise attacks).
+
+A median genuinely needs its inputs side by side, so this aggregator
+cannot stream down to O(1) like the mean family — instead its streaming
+state keeps a **bounded reservoir** (``Settings.AGG_MEDIAN_RESERVOIR``,
+seeded reservoir sampling beyond the cap): the median is exact up to
+the cap, an unbiased sampled median past it, and the round-close stack
+is bounded at reservoir-size x model no matter how many contributors
+report.
 """
 
 from __future__ import annotations
 
+import random
+import zlib
+
 import jax
 import jax.numpy as jnp
 
-from tpfl.learning.aggregators.aggregator import Aggregator, stack_models
+from tpfl.learning.aggregators.aggregator import Aggregator, AggStream
 from tpfl.learning.model import TpflModel
+from tpfl.settings import Settings
 
 
 @jax.jit
@@ -27,14 +39,47 @@ class FedMedian(Aggregator):
     """Element-wise median (unweighted; robust to outliers)."""
 
     SUPPORTS_PARTIAL_AGGREGATION = False
+    SUPPORTS_STREAMING = True
 
-    def aggregate(self, models: list[TpflModel]) -> TpflModel:
-        if not models:
+    def acc_init(self, template: TpflModel) -> AggStream:
+        st = AggStream(template)
+        st.extra["reservoir"] = []
+        # Seeded per-node stream: reservoir eviction is deterministic
+        # under Settings.SEED (it only matters past the cap).
+        st.extra["rng"] = random.Random(
+            (Settings.SEED or 0) ^ zlib.crc32(self.node_name.encode())
+        )
+        return st
+
+    def accumulate(
+        self, state: AggStream, model: TpflModel, weight: "float | None" = None
+    ) -> AggStream:
+        reservoir: list = state.extra["reservoir"]
+        cap = max(1, int(Settings.AGG_MEDIAN_RESERVOIR))
+        if len(reservoir) < cap:
+            reservoir.append(model.get_parameters())
+        else:
+            # Vitter's algorithm R: every contribution seen so far has
+            # equal probability of being in the reservoir.
+            j = state.extra["rng"].randint(0, state.count)
+            if j < cap:
+                reservoir[j] = model.get_parameters()
+        state.contributors.update(model.get_contributors())
+        state.num_samples += model.get_num_samples()
+        state.count += 1
+        state.offered += 1
+        return state
+
+    def finalize(self, state: AggStream) -> TpflModel:
+        reservoir = state.extra.get("reservoir") or []
+        if not reservoir:
             raise ValueError("No models to aggregate")
-        stacked, _ = stack_models(models)
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *reservoir
+        )
         med = _median(stacked)
-        contributors = sorted({c for m in models for c in m.get_contributors()})
-        total = int(sum(m.get_num_samples() for m in models))
-        return models[0].build_copy(
-            params=med, contributors=contributors, num_samples=total
+        return state.template.build_copy(
+            params=med,
+            contributors=sorted(state.contributors),
+            num_samples=int(state.num_samples),
         )

@@ -30,8 +30,12 @@ class FedProx(FedAvg):
         # from the first local fit.
         return {"mu": self.proximal_mu} if name == "fedprox" else {}
 
-    def aggregate(self, models: list[TpflModel]) -> TpflModel:
-        out = super().aggregate(models)
+    def finalize(self, state) -> TpflModel:
+        # Overriding finalize (not aggregate) keeps mu on EVERY result
+        # path: the batch fold, the eager on-arrival stream
+        # (Settings.AGG_STREAM_EAGER), and partial aggregates all close
+        # through finalize.
+        out = super().finalize(state)
         # Ship mu to the clients: learner.set_model routes it into the
         # fedprox callback via additional_info (SCAFFOLD's transport).
         out.add_info("fedprox", {"mu": self.proximal_mu})

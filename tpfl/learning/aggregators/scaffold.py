@@ -21,15 +21,10 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 
-from tpfl.learning.aggregators.aggregator import Aggregator
+from tpfl.learning.aggregators.aggregator import Aggregator, AggStream
 from tpfl.learning.model import TpflModel
 
 INFO_KEY = "scaffold"
-
-
-@jax.jit
-def _tree_mean(stacked):
-    return jax.tree_util.tree_map(lambda x: jnp.mean(x, axis=0), stacked)
 
 
 @jax.jit
@@ -38,14 +33,57 @@ def _tree_axpy(a, x, y):
     return jax.tree_util.tree_map(lambda xi, yi: (yi + a * xi).astype(yi.dtype), x, y)
 
 
-def _stack(trees):
-    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
+@jax.jit
+def _sc_first(dy, dc):
+    """Open the running (sum delta_y, sum delta_c) accumulator."""
+    to_f32 = lambda t: jax.tree_util.tree_map(  # noqa: E731
+        lambda x: x.astype(jnp.promote_types(x.dtype, jnp.float32)), t
+    )
+    return to_f32(dy), to_f32(dc)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _sc_update(acc, dy, dc):
+    """Fold one client's deltas in-place (donated accumulator)."""
+    sdy, sdc = acc
+    add = lambda s, x: jax.tree_util.tree_map(  # noqa: E731
+        lambda a, b: a + b.astype(a.dtype), s, x
+    )
+    return add(sdy, dy), add(sdc, dc)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _sc_mean(acc, n):
+    sdy, sdc = acc
+    div = lambda t: jax.tree_util.tree_map(lambda x: x / n, t)  # noqa: E731
+    return div(sdy), div(sdc)
+
+
+def _client_deltas(m: TpflModel) -> tuple[Any, Any]:
+    info = m.get_info().get(INFO_KEY)
+    if not info or "delta_y_i" not in info or "delta_c_i" not in info:
+        raise ValueError(
+            "SCAFFOLD requires delta_y_i/delta_c_i in model info "
+            "(is the 'scaffold' callback registered on the learner?) "
+            f"— offending model contributors={m.get_contributors()}, "
+            f"info keys={sorted(m.get_info() or {})}"
+        )
+    return (
+        jax.tree_util.tree_map(jnp.asarray, info["delta_y_i"]),
+        jax.tree_util.tree_map(jnp.asarray, info["delta_c_i"]),
+    )
 
 
 class Scaffold(Aggregator):
-    """Controlled averaging with global/local control variates."""
+    """Controlled averaging with global/local control variates.
+
+    The variate means are streaming reductions (donated accumulator —
+    O(1) peak regardless of client count, folded on arrival under
+    ``Settings.AGG_STREAM_EAGER``); the global-state update in
+    ``finalize`` is unchanged from the stacked-mean formulation."""
 
     SUPPORTS_PARTIAL_AGGREGATION = False
+    SUPPORTS_STREAMING = True
     REQUIRED_CALLBACKS = ["scaffold"]
 
     def __init__(self, node_name: str = "unknown", global_lr: float = 1.0) -> None:
@@ -54,58 +92,63 @@ class Scaffold(Aggregator):
         self._global_params: Optional[Any] = None
         self._c: Optional[Any] = None
 
-    def aggregate(self, models: list[TpflModel]) -> TpflModel:
-        if not models:
-            raise ValueError("No models to aggregate")
+    # --- streaming fold ---
+
+    def acc_init(self, template: TpflModel) -> AggStream:
+        return AggStream(template)
+
+    def accumulate(
+        self, state: AggStream, model: TpflModel, weight: "float | None" = None
+    ) -> AggStream:
+        state.offered += 1
         # Skipped fits (num_samples == 0 — interrupted/lapped trainers)
         # did no local steps: they carry no fresh deltas and must not
         # pull the control variates toward zero (or, worse, replay a
         # stale round's info). Ignore them entirely.
-        trained = [m for m in models if m.get_num_samples() > 0]
-        if not trained:
+        if model.get_num_samples() <= 0:
+            return state
+        dy, dc = _client_deltas(model)
+        if state.acc is None:
+            state.acc = _sc_first(dy, dc)
+            # Recover the common round-start point x from any client:
+            # y_i = x + delta_y_i  =>  x = y_0 - delta_y_0. (Only
+            # needed the first time — afterwards the maintained global
+            # model is the anchor.)
+            if self._global_params is None:
+                state.extra["x0"] = jax.tree_util.tree_map(
+                    lambda y, d: y - d.astype(y.dtype),
+                    model.get_parameters(),
+                    dy,
+                )
+            state.template = model
+        else:
+            state.acc = _sc_update(state.acc, dy, dc)
+        state.contributors.update(model.get_contributors())
+        state.num_samples += model.get_num_samples()
+        state.count += 1
+        return state
+
+    def finalize(self, state: AggStream) -> TpflModel:
+        if state.count == 0 or state.acc is None:
             raise ValueError(
                 "No trained models to aggregate (all contributions "
                 "have num_samples == 0)"
             )
-        models = trained
-        delta_ys, delta_cs = [], []
-        for m in models:
-            info = m.get_info().get(INFO_KEY)
-            if not info or "delta_y_i" not in info or "delta_c_i" not in info:
-                raise ValueError(
-                    "SCAFFOLD requires delta_y_i/delta_c_i in model info "
-                    "(is the 'scaffold' callback registered on the learner?) "
-                    f"— offending model contributors={m.get_contributors()}, "
-                    f"info keys={sorted(m.get_info() or {})}"
-                )
-            delta_ys.append(
-                jax.tree_util.tree_map(jnp.asarray, info["delta_y_i"])
-            )
-            delta_cs.append(
-                jax.tree_util.tree_map(jnp.asarray, info["delta_c_i"])
-            )
-
-        mean_dy = _tree_mean(_stack(delta_ys))
-        mean_dc = _tree_mean(_stack(delta_cs))
+        mean_dy, mean_dc = _sc_mean(state.acc, jnp.float32(state.count))
+        state.acc = None  # donated — single use
 
         if self._global_params is None:
-            # Recover the common round-start point x from any client:
-            # y_i = x + delta_y_i  =>  x = y_0 - delta_y_0.
-            self._global_params = jax.tree_util.tree_map(
-                lambda y, d: y - d.astype(y.dtype),
-                models[0].get_parameters(),
-                delta_ys[0],
-            )
+            self._global_params = state.extra["x0"]
         self._global_params = _tree_axpy(self.global_lr, mean_dy, self._global_params)
 
         if self._c is None:
             self._c = jax.tree_util.tree_map(jnp.zeros_like, mean_dc)
         self._c = _tree_axpy(1.0, mean_dc, self._c)
 
-        contributors = sorted({c for m in models for c in m.get_contributors()})
-        total = int(sum(m.get_num_samples() for m in models))
-        out = models[0].build_copy(
-            params=self._global_params, contributors=contributors, num_samples=total
+        out = state.template.build_copy(
+            params=self._global_params,
+            contributors=sorted(state.contributors),
+            num_samples=int(state.num_samples),
         )
         out.add_info(INFO_KEY, {"global_c": self._c})
         return out

@@ -150,7 +150,9 @@ def _topk_encode(x, k):
 def _fp_update(h, arr: np.ndarray) -> None:
     h.update(str(arr.dtype).encode())
     h.update(str(arr.shape).encode())
-    h.update(np.ascontiguousarray(arr).tobytes())
+    # leaf_bytes borrows the array's storage (no tobytes copy) —
+    # hashlib consumes the memoryview directly.
+    h.update(serialization.leaf_bytes(arr))
 
 
 def pytree_fingerprint(tree: Any) -> bytes:
@@ -225,18 +227,22 @@ def _encode_leaf(a: np.ndarray, bits: int, topk_frac: float) -> Any:
         k = max(1, int(np.ceil(arr.size * float(topk_frac))))
         idx, vals = _topk_encode(x, k)
         rec[_TK_KEY] = 1
-        rec["i"] = np.asarray(idx).tobytes()
+        # leaf_bytes: borrowed views over the device->host transfer
+        # buffers — msgpack copies each exactly once into the body
+        # instead of tobytes() copying first (one copy per leaf, not
+        # two; same discipline as the v3 dense layout).
+        rec["i"] = serialization.leaf_bytes(np.asarray(idx))
         if bits & QUANT8:
             q, scale = _q8_encode(vals)
-            rec["q"] = np.asarray(q).tobytes()
+            rec["q"] = serialization.leaf_bytes(np.asarray(q))
             rec["sc"] = float(scale)
         else:
-            rec["v"] = np.asarray(vals, np.float32).tobytes()
+            rec["v"] = serialization.leaf_bytes(np.asarray(vals, np.float32))
         return rec
     if bits & QUANT8:
         q, scale = _q8_encode(x)
         rec[_Q8_KEY] = 1
-        rec["q"] = np.asarray(q).tobytes()
+        rec["q"] = serialization.leaf_bytes(np.asarray(q))
         rec["sc"] = float(scale)
         return rec
     return dense
@@ -366,20 +372,23 @@ def _entropy_decode(body: bytes, bits: int) -> bytes:
 # --- envelope ---
 
 
-def payload_version(data: bytes) -> int:
-    """1 for legacy dense payloads, 2 for codec envelopes. O(1)."""
-    return WIRE_VERSION_2 if data[:1] == _V2_PREFIX else 1
+def payload_version(data: Any) -> int:
+    """1 for legacy dense payloads, 2 for codec envelopes, 3 for the
+    zero-copy header+payload layout, 0 for an in-process by-reference
+    payload (no bytes at all). O(1)."""
+    return serialization.payload_wire_version(data)
 
 
-def payload_codec(data: bytes) -> int:
-    """The envelope's codec-id byte (0 = dense v1). O(1)."""
+def payload_codec(data: Any) -> int:
+    """The envelope's codec-id byte (0 = dense v1/v3/by-reference). O(1)."""
     return data[1] if payload_version(data) == WIRE_VERSION_2 else 0
 
 
-def payload_is_delta(data: bytes) -> bool:
+def payload_is_delta(data: Any) -> bool:
     """True when ``data`` is a residual payload that needs a base to
     decode — relays must not forward it verbatim to peers that may not
-    hold the base. O(1): reads the codec-id byte only."""
+    hold the base. O(1): reads the codec-id byte only. By-reference
+    payloads are never residual (they ARE the decoded full model)."""
     return bool(payload_codec(data) & DELTA)
 
 

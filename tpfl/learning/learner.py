@@ -16,6 +16,7 @@ from __future__ import annotations
 from abc import ABC, abstractmethod
 from typing import Any, Optional, Union
 
+from tpfl.learning import serialization
 from tpfl.learning.callbacks import CallbackFactory, TpflCallback
 from tpfl.learning.dataset.tpfl_dataset import TpflDataset
 from tpfl.learning.model import TpflModel
@@ -64,8 +65,9 @@ class Learner(ABC):
         else:
             if self._model is None:
                 raise ValueError("No base model to set parameters into")
-            if isinstance(model, bytes):
-                # REBIND, don't mutate: wire bytes carry contributors +
+            if isinstance(model, bytes) or serialization.is_byref(model):
+                # REBIND, don't mutate: wire payloads (encoded bytes OR
+                # a zero-copy InprocModelRef) carry contributors +
                 # info, and the current object may be mid-fit on the
                 # training thread (a lapped trainer receiving the round's
                 # full model). Overwriting it in place would poison the

@@ -69,6 +69,11 @@ class TpflModel:
         # lets residual wire payloads decode against the round bases this
         # node has adopted. None = delta payloads are refused.
         self.base_store: Any = None
+        # Per-node serialization buffer pool (tpfl.learning.bufferpool,
+        # attached by Node and inherited through build_copy): v3 encodes
+        # stage into a reused buffer instead of allocating multi-MB
+        # bytes per gossip tick. None = the process default pool.
+        self.buffer_pool: Any = None
 
     # --- parameters ---
 
@@ -88,7 +93,13 @@ class TpflModel:
         if isinstance(params, TpflModel):
             self._check_and_set(params.get_parameters())
             return
-        if isinstance(params, bytes):
+        if isinstance(params, (bytes, serialization.InprocModelRef)):
+            # Wire intake: encoded bytes (any version — v1/v2/v3
+            # dispatch in decode_model_payload) or a by-reference
+            # in-process payload. The ref path shares the sender's
+            # immutable jax leaves outright (jnp.asarray of a jax array
+            # is the SAME object — zero copy); frozen numpy leaves are
+            # promoted to device copies by the same asarray.
             decoded, contribs, n, info = serialization.decode_model_payload(
                 params, bases=self.base_store
             )
@@ -189,8 +200,31 @@ class TpflModel:
                 else p,
                 params,
             )
+        if int(Settings.WIRE_FORMAT) >= 3:
+            # Zero-copy dense layout: one pooled contiguous payload,
+            # each leaf written exactly once (Settings.WIRE_FORMAT docs;
+            # set 1 when a pre-v3 peer must decode our payloads).
+            return serialization.encode_model_payload_v3(
+                params,
+                self._contributors,
+                self._num_samples,
+                self.additional_info,
+                pool=self.buffer_pool,
+            )
         return serialization.encode_model_payload(
             params,
+            self._contributors,
+            self._num_samples,
+            self.additional_info,
+        )
+
+    def as_ref(self) -> "serialization.InprocModelRef":
+        """By-reference payload for co-located nodes
+        (``Settings.INPROC_ZERO_COPY``): no encode, no decode, no bytes
+        — the parameter pytree is handed across with frozen leaves and
+        copied metadata. Only the in-memory transport may carry one."""
+        return serialization.InprocModelRef(
+            self._params,
             self._contributors,
             self._num_samples,
             self.additional_info,
@@ -248,10 +282,11 @@ class TpflModel:
         )
         # Wire-intake chain: aggregates/partials derive from a wire model
         # via build_copy, and delta decodes anywhere downstream need the
-        # same base resolver.
+        # same base resolver (and the node's serialization buffer pool).
         m.base_store = self.base_store
+        m.buffer_pool = self.buffer_pool
         if params is not None:
-            if isinstance(params, bytes):
+            if isinstance(params, (bytes, serialization.InprocModelRef)):
                 decoded, contribs, n, info = serialization.decode_model_payload(
                     params, bases=self.base_store
                 )

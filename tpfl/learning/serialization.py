@@ -3,8 +3,8 @@
 The reference ships weights as pickled lists of numpy arrays
 (``p2pfl/learning/frameworks/p2pfl_model.py:71-101``) — a security hole
 (arbitrary code execution on unpickle) and a dtype hazard. tpfl instead
-uses a msgpack envelope in which every array leaf is encoded as
-``{dtype, shape, raw bytes}`` and pytree structure is preserved as plain
+uses versioned msgpack envelopes in which every array leaf is encoded as
+dtype/shape-tagged raw bytes and pytree structure is preserved as plain
 msgpack maps/lists. Decoding never executes code.
 
 Wire envelope (version 1)::
@@ -17,13 +17,41 @@ Wire envelope (version 1)::
 
 Version 2 envelopes (compressed / residual payloads, leading ``0x02``
 byte — a v1 payload is a msgpack map and can never start with 0x02)
-live in :mod:`tpfl.learning.compression`; ``decode_model_payload``
-dispatches on the version so every decode site handles both.
+live in :mod:`tpfl.learning.compression`.
+
+Wire envelope (version 3, leading ``0x03`` byte) — the zero-copy
+layout::
+
+    b"\\x03" | uint32-LE header length | msgpack header | payload
+
+    header = {"params": <tree of leaf descriptors>,
+              "contributors": [...], "num_samples": int,
+              "info": <tree of leaf descriptors>, "psz": payload bytes}
+    leaf descriptor = {"__nd__": 3, "d": dtype, "s": shape,
+                       "o": offset, "n": nbytes}
+
+All leaf bytes live in ONE contiguous payload region (offsets 64-byte
+aligned). Encode is a single ``bytes.join`` over borrowed leaf views —
+each payload byte is copied exactly once, straight into the final wire
+object — with non-contiguous leaves gathered through a reusable
+per-node :class:`~tpfl.learning.bufferpool.BufferPool` scratch; decode
+returns **zero-copy read-only array views** into the received bytes —
+no per-leaf allocation at all. Consumers that need to
+mutate promote by copying (``jnp.asarray`` device upload does this
+naturally); a write to a view raises. ``decode_model_payload``
+dispatches on the version byte, so v1/v2/v3 all decode everywhere.
+
+For co-located nodes the in-memory transport can skip bytes entirely:
+:class:`InprocModelRef` hands the decoded pytree across by reference
+(``Settings.INPROC_ZERO_COPY``), with numpy leaves frozen read-only and
+metadata copied so neither side can mutate the other.
 """
 
 from __future__ import annotations
 
-from typing import Any
+import math
+import struct
+from typing import Any, Optional
 
 import msgpack
 import numpy as np
@@ -34,26 +62,78 @@ _ND_KEY = "__nd__"
 _TUPLE_KEY = "__tp__"
 
 WIRE_VERSION = 1
+WIRE_VERSION_3 = 3
+_V3_PREFIX = bytes([WIRE_VERSION_3])
+_V3_ALIGN = 64
+
+
+# dtype <-> name caches: numpy's ``dtype.name`` property rebuilds the
+# string on every access (it was the single hottest call in the encode
+# profile), and ``np.dtype(name)`` re-parses on decode. Both are pure.
+_DTYPE_NAMES: dict = {}
+_NAME_DTYPES: dict = {}
+
+
+def _dtype_name(dt: np.dtype) -> str:
+    name = _DTYPE_NAMES.get(dt)
+    if name is None:
+        name = _DTYPE_NAMES[dt] = dt.name
+    return name
 
 
 def _resolve_dtype(name: str) -> np.dtype:
     """np.dtype from name, covering ml_dtypes extension types (bfloat16,
     float8_*) that numpy alone does not know."""
+    dt = _NAME_DTYPES.get(name)
+    if dt is not None:
+        return dt
     try:
-        return np.dtype(name)
+        dt = np.dtype(name)
     except TypeError:
         import ml_dtypes
 
-        return np.dtype(getattr(ml_dtypes, name))
+        dt = np.dtype(getattr(ml_dtypes, name))
+    _NAME_DTYPES[name] = dt
+    return dt
+
+
+def _as_contiguous(a: np.ndarray) -> np.ndarray:
+    """C-contiguous view-or-copy — copies ONLY when the layout demands
+    it (transposed/sliced leaves; plain arrays pass through untouched)."""
+    return a if a.flags.c_contiguous else np.ascontiguousarray(a)
+
+
+def leaf_bytes(a: np.ndarray) -> "memoryview | bytes":
+    """Raw bytes of an array leaf WITHOUT the ``tobytes()`` copy when
+    the layout allows: a contiguous array is exposed as a memoryview
+    over its own storage (msgpack, zlib, hashlib and memoryview-slice
+    assignment all consume it directly). Extension dtypes that cannot
+    export the buffer protocol (ml_dtypes bfloat16/float8) go through a
+    uint8 reinterpret view; ``tobytes()`` remains only as the last
+    fallback. The ONLY sanctioned byte-extraction helper outside jitted
+    code — ``tools/wirecheck.py check_copies`` lints stray copies."""
+    a = _as_contiguous(np.asarray(a))
+    flat = a.reshape(-1)  # 0-d -> (1,); reshape of contiguous is a view
+    try:
+        return memoryview(flat).cast("B")
+    except (TypeError, ValueError):
+        pass
+    try:
+        return memoryview(flat.view(np.uint8))
+    except (TypeError, ValueError):
+        return a.tobytes()
 
 
 def _encode_obj(obj: Any) -> Any:
     """Recursively encode a pytree of arrays/scalars into msgpack-safe types."""
     # jax.Array, np.ndarray, np scalar — all become tagged raw buffers
     if hasattr(obj, "__array__") and not isinstance(obj, (bool, int, float, str)):
-        a = np.asarray(obj)
-        # dtype.name (not .str) so ml_dtypes types like bfloat16 survive
-        return {_ND_KEY: 1, "d": a.dtype.name, "s": list(a.shape), "b": a.tobytes()}
+        a = _as_contiguous(np.asarray(obj))
+        # dtype.name (not .str) so ml_dtypes types like bfloat16 survive;
+        # leaf_bytes borrows the array's storage (no copy) — msgpack
+        # copies it once into the output, which is the single copy the
+        # v1 envelope pays per leaf.
+        return {_ND_KEY: 1, "d": _dtype_name(a.dtype), "s": list(a.shape), "b": leaf_bytes(a)}
     if isinstance(obj, dict):
         return {k: _encode_obj(v) for k, v in obj.items()}
     if isinstance(obj, tuple):
@@ -65,11 +145,34 @@ def _encode_obj(obj: Any) -> Any:
     raise TypeError(f"Cannot serialize object of type {type(obj)}")
 
 
+def _leaf_view(
+    buf: Any, dtype: np.dtype, shape: tuple, offset: int, nbytes: int
+) -> np.ndarray:
+    """Zero-copy read-only array view over ``buf[offset:offset+nbytes]``.
+
+    Shape ``()`` (0-d) and zero-size shapes (``(0,)``, ``(0, k)``) take
+    the SAME construction path as every other leaf — the v1 decoder
+    historically special-cased neither, so a 0-d scalar round-tripped
+    through ``frombuffer`` shape-dependently. ``count`` is always the
+    exact element count (1 for 0-d, 0 for empty), never -1."""
+    count = math.prod(shape) if shape else 1
+    if count == 0:
+        a = np.empty(shape, dtype)
+        a.flags.writeable = False
+        return a
+    a = np.frombuffer(buf, dtype=dtype, count=count, offset=offset).reshape(shape)
+    if a.flags.writeable:  # writable source (bytearray/pooled) — freeze
+        a.flags.writeable = False
+    return a
+
+
 def _decode_obj(obj: Any) -> Any:
     if isinstance(obj, dict):
         if obj.get(_ND_KEY) == 1:
-            a = np.frombuffer(obj["b"], dtype=_resolve_dtype(obj["d"]))
-            return a.reshape(obj["s"])
+            raw = obj["b"]
+            dtype = _resolve_dtype(obj["d"])
+            shape = tuple(obj["s"])
+            return _leaf_view(raw, dtype, shape, 0, len(raw))
         if _TUPLE_KEY in obj and len(obj) == 1:
             return tuple(_decode_obj(v) for v in obj[_TUPLE_KEY])
         return {k: _decode_obj(v) for k, v in obj.items()}
@@ -96,8 +199,9 @@ def encode_model_payload(
     num_samples: int,
     additional_info: dict[str, Any],
 ) -> bytes:
-    """Full wire envelope for a model exchange (replaces
-    p2pfl_model.py:71-85's pickle)."""
+    """v1 wire envelope (legacy dense msgpack map — what old peers
+    decode). New code paths emit v3 via :func:`encode_model_payload_v3`
+    (``Settings.WIRE_FORMAT``); this stays the interop encoder."""
     env = {
         "v": WIRE_VERSION,
         "params": _encode_obj(params),
@@ -108,18 +212,269 @@ def encode_model_payload(
     return msgpack.packb(env, use_bin_type=True)
 
 
-def decode_model_payload(
-    data: bytes, bases: Any = None
+# --- v3: header + one contiguous pooled payload ---------------------------
+
+
+_PAD = bytes(_V3_ALIGN)
+
+
+class _Scratch:
+    """Pooled contiguation scratch for one encode: a non-C-contiguous
+    leaf (transposed/sliced view) must be gathered before its bytes can
+    be borrowed, and doing that through the node's BufferPool instead
+    of a fresh allocation per leaf per encode keeps the gossip hot loop
+    allocation-free. Context-managed — error paths release every
+    lease."""
+
+    __slots__ = ("_pool", "_leases")
+
+    def __init__(self, pool: Any) -> None:
+        self._pool = pool
+        self._leases: list = []
+
+    def gather(self, a: np.ndarray) -> np.ndarray:
+        if self._pool is None:
+            from tpfl.learning.bufferpool import default_pool
+
+            self._pool = default_pool()
+        lease = self._pool.acquire(a.nbytes)
+        self._leases.append(lease)
+        out = np.frombuffer(lease.view(), dtype=a.dtype, count=a.size).reshape(
+            a.shape
+        )
+        np.copyto(out, a)
+        return out
+
+    def __enter__(self) -> "_Scratch":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        for lease in self._leases:
+            lease.release()
+        self._leases.clear()
+
+
+def _v3_plan(obj: Any, metas: list, offset: list, scratch: _Scratch) -> Any:
+    """Walk a pytree, emitting header descriptors and assigning each
+    array leaf an aligned slot in the payload region. ``metas`` collects
+    ``(contiguous array, offset, nbytes)`` instructions; non-contiguous
+    leaves gather into pooled scratch (only when the layout demands
+    it)."""
+    if hasattr(obj, "__array__") and not isinstance(obj, (bool, int, float, str)):
+        a = np.asarray(obj)
+        if not a.flags.c_contiguous:
+            a = scratch.gather(a)
+        off = (offset[0] + _V3_ALIGN - 1) & ~(_V3_ALIGN - 1)
+        offset[0] = off + a.nbytes
+        metas.append((a, off, a.nbytes))
+        return {_ND_KEY: 3, "d": _dtype_name(a.dtype), "s": list(a.shape), "o": off, "n": a.nbytes}
+    if isinstance(obj, dict):
+        return {k: _v3_plan(v, metas, offset, scratch) for k, v in obj.items()}
+    if isinstance(obj, tuple):
+        return {_TUPLE_KEY: [_v3_plan(v, metas, offset, scratch) for v in obj]}
+    if isinstance(obj, list):
+        return [_v3_plan(v, metas, offset, scratch) for v in obj]
+    if obj is None or isinstance(obj, (bool, int, float, str, bytes)):
+        return obj
+    raise TypeError(f"Cannot serialize object of type {type(obj)}")
+
+
+def _leaf_u8(a: np.ndarray) -> Any:
+    """Borrowed buffer-protocol view of a contiguous leaf's bytes —
+    ``bytes.join`` consumes it directly, so the leaf is copied exactly
+    once, straight into the final payload object."""
+    try:
+        return a.reshape(-1).view(np.uint8)
+    except (TypeError, ValueError):
+        return leaf_bytes(a)
+
+
+def encode_model_payload_v3(
+    params: Any,
+    contributors: list[str],
+    num_samples: int,
+    additional_info: dict[str, Any],
+    pool: Any = None,
+) -> bytes:
+    """v3 wire envelope: msgpack header (dtype/shape/offset table) +
+    ONE contiguous payload. Assembly is a single ``bytes.join`` over
+    borrowed leaf views — every payload byte is copied exactly once,
+    directly into the final wire object (no per-leaf ``tobytes()``, no
+    msgpack buffer growth, no staging copy). ``pool``: a
+    :class:`~tpfl.learning.bufferpool.BufferPool` backing the
+    contiguation scratch for strided leaves (default: the process
+    pool; plain contiguous leaves never touch it)."""
+    metas: list = []
+    offset = [0]
+    with _Scratch(pool) as scratch:
+        header_tree = {
+            "params": _v3_plan(params, metas, offset, scratch),
+            "contributors": list(contributors),
+            "num_samples": int(num_samples),
+            "info": _v3_plan(additional_info, metas, offset, scratch),
+            "psz": offset[0],
+        }
+        header = msgpack.packb(header_tree, use_bin_type=True)
+        parts: list = [_V3_PREFIX, struct.pack("<I", len(header)), header]
+        end = 0
+        for a, off, nbytes in metas:
+            if off > end:
+                # Deterministic zero padding in the alignment gaps
+                # (payload bytes are hashed by the election beacon and
+                # compared by gossip byte caches).
+                parts.append(_PAD[: off - end])
+            if nbytes:
+                parts.append(_leaf_u8(a))
+            end = off + nbytes
+        # The single copy: join gathers every part into the exact-size
+        # immutable wire object. Scratch leases release on exit.
+        return b"".join(parts)
+
+
+def _decode_v3_tree(obj: Any, data: Any, base: int, end: int) -> Any:
+    if isinstance(obj, dict):
+        if obj.get(_ND_KEY) == 3:
+            dtype = _resolve_dtype(obj["d"])
+            shape = tuple(obj["s"])
+            off, nbytes = int(obj["o"]), int(obj["n"])
+            if off < 0 or base + off + nbytes > end:
+                raise DecodingParamsError(
+                    f"v3 leaf [{off}:{off + nbytes}] outside payload"
+                )
+            return _leaf_view(data, dtype, shape, base + off, nbytes)
+        if _TUPLE_KEY in obj and len(obj) == 1:
+            return tuple(
+                _decode_v3_tree(v, data, base, end) for v in obj[_TUPLE_KEY]
+            )
+        return {k: _decode_v3_tree(v, data, base, end) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_decode_v3_tree(v, data, base, end) for v in obj]
+    return obj
+
+
+def _decode_model_payload_v3(
+    data: bytes,
 ) -> tuple[Any, list[str], int, dict[str, Any]]:
-    """Decode any wire version. v1 (legacy dense msgpack map) is handled
-    here; v2 codec envelopes (leading ``0x02`` version byte — quantized /
-    sparsified / entropy-coded / residual payloads) dispatch to
+    try:
+        if len(data) < 5:
+            raise DecodingParamsError("v3 payload shorter than its preamble")
+        (hlen,) = struct.unpack_from("<I", data, 1)
+        base = 5 + hlen
+        if base > len(data):
+            raise DecodingParamsError("v3 header truncated")
+        env = msgpack.unpackb(data[5:base], raw=False, strict_map_key=False)
+        end = base + int(env["psz"])
+        if end > len(data):
+            raise DecodingParamsError(
+                f"v3 payload truncated: need {end} bytes, have {len(data)}"
+            )
+        return (
+            _decode_v3_tree(env["params"], data, base, end),
+            list(env["contributors"]),
+            int(env["num_samples"]),
+            _decode_v3_tree(env["info"], data, base, end),
+        )
+    except DecodingParamsError:
+        raise
+    except (msgpack.UnpackException, struct.error, ValueError, KeyError,
+            TypeError, AttributeError) as e:
+        raise DecodingParamsError(f"Corrupt v3 payload: {e}") from e
+
+
+# --- by-reference payloads (co-located nodes) -----------------------------
+
+
+def _freeze_leaf(x: Any) -> Any:
+    """Immutability guard for by-reference handoff: numpy leaves become
+    read-only VIEWS (zero-copy — a write at the receiver raises instead
+    of corrupting the sender); jax arrays are immutable already and pass
+    through by reference; scalars/strings are immutable."""
+    if isinstance(x, np.ndarray):
+        v = x.view()
+        v.flags.writeable = False
+        return v
+    return x
+
+
+def freeze_tree(tree: Any) -> Any:
+    import jax
+
+    return jax.tree_util.tree_map(_freeze_leaf, tree)
+
+
+class InprocModelRef:
+    """A model payload passed BY REFERENCE between co-located nodes
+    (``Settings.INPROC_ZERO_COPY``): the already-decoded parameter
+    pytree plus copied contributor metadata — no encode, no decode, no
+    bytes. Leaves are frozen (read-only numpy views / immutable jax
+    arrays); receivers that mutate promote to their own copy via the
+    normal device upload in ``TpflModel._check_and_set``. Never crosses
+    a process boundary — the gRPC transport raises if one reaches its
+    wire framing."""
+
+    __slots__ = ("params", "contributors", "num_samples", "info")
+
+    def __init__(
+        self,
+        params: Any,
+        contributors: list[str],
+        num_samples: int,
+        info: dict[str, Any],
+    ) -> None:
+        self.params = freeze_tree(params)
+        # Metadata is COPIED, not shared: the receiver updates its own
+        # contributor lists/info dicts and must not reach back into the
+        # sender's model.
+        self.contributors = list(contributors)
+        self.num_samples = int(num_samples)
+        self.info = {k: _freeze_leaf(v) for k, v in dict(info).items()}
+
+    def __len__(self) -> int:
+        # Payload accounting sites treat refs as size-0: no bytes moved.
+        return 0
+
+    def __repr__(self) -> str:
+        return (
+            f"InprocModelRef(contributors={self.contributors}, "
+            f"num_samples={self.num_samples})"
+        )
+
+
+def is_byref(payload: Any) -> bool:
+    return isinstance(payload, InprocModelRef)
+
+
+# --- versioned decode dispatch --------------------------------------------
+
+
+def payload_wire_version(data: Any) -> int:
+    """1 / 2 / 3 from the leading byte; 0 for a by-reference payload."""
+    if is_byref(data):
+        return 0
+    lead = bytes(data[:1])
+    if lead == b"\x02":
+        return 2
+    if lead == _V3_PREFIX:
+        return WIRE_VERSION_3
+    return WIRE_VERSION
+
+
+def decode_model_payload(
+    data: Any, bases: Any = None
+) -> tuple[Any, list[str], int, dict[str, Any]]:
+    """Decode any wire version (or an :class:`InprocModelRef`). v1
+    (legacy dense msgpack map) and v3 (zero-copy header+payload) are
+    handled here; v2 codec envelopes (leading ``0x02`` byte) dispatch to
     :mod:`tpfl.learning.compression`, with ``bases`` resolving residual
     (delta) payloads to their base model."""
+    if is_byref(data):
+        return (data.params, list(data.contributors), data.num_samples, dict(data.info))
     if data[:1] == b"\x02":
         from tpfl.learning import compression
 
         return compression.decode_model_payload(data, bases=bases)
+    if data[:1] == _V3_PREFIX:
+        return _decode_model_payload_v3(data)
     try:
         env = msgpack.unpackb(data, raw=False, strict_map_key=False)
         if env.get("v") != WIRE_VERSION:

@@ -99,6 +99,18 @@ class Node:
         # intake via build_copy, aggregates) inherits the resolver, so
         # residual payloads decode against the bases this node adopted.
         self.learner.get_model().base_store = self.state.wire_bases
+        # Zero-copy model plane: a per-node reusable serialization
+        # buffer (tpfl.learning.bufferpool) — v3 encodes stage into it
+        # instead of allocating fresh multi-MB bytes per gossip tick;
+        # inherited by every wire-derived model copy alongside the
+        # base resolver.
+        from tpfl.learning.bufferpool import BufferPool
+
+        self.buffer_pool = BufferPool(
+            max_buffers=Settings.BUFFER_POOL_BUFFERS,
+            max_bytes=Settings.BUFFER_POOL_MAX_BYTES,
+        )
+        self.learner.get_model().buffer_pool = self.buffer_pool
 
         # Experiment parameters (set by set_start_learning / command)
         self.rounds: int = 0

@@ -191,6 +191,42 @@ class Settings:
     and votes no longer queue behind a model transfer on the wire
     (head-of-line). 0 disables chunking."""
 
+    # --- zero-copy model plane ---
+    WIRE_FORMAT: int = 3
+    """Dense model-payload envelope version. 3 (default): the zero-copy
+    layout — msgpack header (dtype/shape/offset table) + ONE contiguous
+    payload staged through the node's BufferPool; encode writes each
+    leaf's bytes exactly once, decode returns read-only memoryview-
+    backed array views with zero per-leaf copies. 1: the legacy dense
+    msgpack map, for federations that still contain pre-v3 peers (every
+    tpfl node decodes v1, v2 AND v3 regardless of this setting — it
+    only selects what WE emit). Compressed codecs (WIRE_CODEC) emit v2
+    envelopes independently of this knob."""
+
+    INPROC_ZERO_COPY: bool = False
+    """In-memory transport fast path: hand model payloads between
+    co-located nodes BY REFERENCE (tpfl.learning.serialization
+    .InprocModelRef) — no encode, no decode, no bytes at all. Leaves
+    are frozen (read-only numpy views; jax arrays are immutable) and
+    contributor metadata is copied, so neither side can mutate the
+    other (tests/test_zero_copy.py asserts non-aliasing under both
+    settings). gRPC federations are unaffected: the flag only takes
+    effect on transports that declare ZERO_COPY_INPROC, and the wire
+    bytes of every gRPC payload stay identical either way. Off by
+    default for reference parity; the scale profile enables it — at
+    1000 single-host nodes the encode/decode of every gossip push was
+    memcpy the receiver shares an address space with."""
+
+    BUFFER_POOL_BUFFERS: int = 8
+    """Max reusable serialization buffers a BufferPool retains
+    (tpfl.learning.bufferpool). The steady state is one buffer per
+    node, reused every encode; extras cover concurrent encode paths
+    (gossiper + relay + init diffusion)."""
+
+    BUFFER_POOL_MAX_BYTES: int = 256 * 1024 * 1024
+    """Cap on the total bytes a BufferPool may keep pooled. Returned
+    buffers that would exceed it are freed instead of pooled."""
+
     # --- SSL / mTLS ---
     USE_SSL: bool = False
     CA_CRT: str = ""
@@ -204,6 +240,28 @@ class Settings:
     VOTE_TIMEOUT: float = 60.0
     AGGREGATION_TIMEOUT: float = 300.0
     WAIT_HEARTBEATS_CONVERGENCE: float = 0.2
+
+    # --- aggregation (streaming accumulators) ---
+    AGG_STREAM_EAGER: bool = True
+    """Fold contributions into the aggregator's on-device running
+    accumulator AS THEY ARRIVE (Aggregator.accumulate/finalize) instead
+    of reducing everything at round close. Peak memory for mean-style
+    aggregators (FedAvg/FedProx/SCAFFOLD) is O(1 model) either way —
+    the batch path also folds sequentially with buffer donation — but
+    the eager path moves the reduce off the round's critical tail: by
+    the time coverage completes, the aggregate is one finalize away.
+    Trade-off: the fold runs in ARRIVAL order, so bit-exact
+    run-to-run reproducibility of the aggregate (float addition is not
+    associative) requires False, which folds the held models in
+    canonical sorted order at close instead. The test and standalone
+    profiles set False (exactness/reference parity first); the scale
+    profile sets True."""
+
+    AGG_MEDIAN_RESERVOIR: int = 64
+    """FedMedian's streaming state keeps at most this many contributions
+    (seeded reservoir sampling beyond it) — an exact median up to the
+    cap, an unbiased sampled median past it, and bounded memory at any
+    federation size."""
 
     ROUND_QUORUM: float = 1.0
     """Fraction of the *live* train set whose contributions close a
@@ -289,11 +347,21 @@ class Settings:
         cls.LOG_LEVEL = "DEBUG"
         cls.ASYNC_LOGGER = False
         cls.FILE_LOGGER = False
-        # Exactness first in tests: dense v1 payloads, no residual
-        # gossip; codec tests opt in explicitly.
+        # Exactness first in tests: dense payloads (v3 zero-copy layout
+        # — still exact), no residual gossip; codec tests opt in
+        # explicitly. Zero-copy stays byte-path (INPROC_ZERO_COPY off)
+        # and aggregation folds in canonical order at round close
+        # (AGG_STREAM_EAGER off) so seeded runs are bit-reproducible;
+        # the zero-copy/eager tests toggle both per-case.
         cls.WIRE_CODEC = "dense"
         cls.WIRE_DELTA = False
+        cls.WIRE_FORMAT = 3
         cls.WIRE_CHUNK_SIZE = 256 * 1024
+        cls.INPROC_ZERO_COPY = False
+        cls.AGG_STREAM_EAGER = False
+        cls.AGG_MEDIAN_RESERVOIR = 64
+        cls.BUFFER_POOL_BUFFERS = 8
+        cls.BUFFER_POOL_MAX_BYTES = 256 * 1024 * 1024
         # Fault tolerance: short backoffs (tests run against loopback),
         # fast half-open probes; quorum at reference behavior — chaos
         # tests override per-case.
@@ -323,9 +391,19 @@ class Settings:
         cls.WAIT_HEARTBEATS_CONVERGENCE = 4.0
         cls.LOG_LEVEL = "INFO"
         # Single-host, handful of nodes: bytes are not the bottleneck —
-        # keep the exact dense wire (reference-parity behavior).
+        # keep the exact dense wire (reference-parity behavior; the v3
+        # layout is exact, only the framing differs). By-reference
+        # handoff and eager accumulation stay off: reference parity
+        # over speed in this profile, and close-time sorted folds keep
+        # seeded runs bit-reproducible.
         cls.WIRE_CODEC = "dense"
         cls.WIRE_DELTA = False
+        cls.WIRE_FORMAT = 3
+        cls.INPROC_ZERO_COPY = False
+        cls.AGG_STREAM_EAGER = False
+        cls.AGG_MEDIAN_RESERVOIR = 64
+        cls.BUFFER_POOL_BUFFERS = 8
+        cls.BUFFER_POOL_MAX_BYTES = 256 * 1024 * 1024
         # Fault tolerance: patient backoffs matching the long protocol
         # timeouts; quorum at reference behavior.
         cls.RETRY_MAX_ATTEMPTS = 3
@@ -388,6 +466,19 @@ class Settings:
         # aggregate wherever the peer acknowledged holding it.
         cls.WIRE_CODEC = "quant8+zlib"
         cls.WIRE_DELTA = True
+        cls.WIRE_FORMAT = 3
+        # 1000 co-located nodes share one address space: hand model
+        # payloads across by reference (no encode/decode/memcpy per
+        # hop) and fold contributions into the on-device accumulator
+        # as they arrive — together these make the round memcpy-free
+        # between fit and finalize. (Dense fallback payloads that DO
+        # encode — codec nacks, gRPC peers — stage through the
+        # per-node BufferPool instead of allocating per tick.)
+        cls.INPROC_ZERO_COPY = True
+        cls.AGG_STREAM_EAGER = True
+        cls.AGG_MEDIAN_RESERVOIR = 64
+        cls.BUFFER_POOL_BUFFERS = 8
+        cls.BUFFER_POOL_MAX_BYTES = 256 * 1024 * 1024
         # Fault tolerance: only one retry — backoff sleeps run on
         # contended sender threads (gossiper/heartbeater share the GIL
         # with 1000 in-process nodes), and the breaker caps what a dead

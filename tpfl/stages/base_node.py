@@ -103,8 +103,10 @@ class StartLearningStage(Stage):
             ]
 
         # Encode once: params are fixed during init diffusion, and at a
-        # tree hub re-encoding per push is the dominant cost.
-        init_payload = node.learner.get_model().encode_parameters()
+        # tree hub re-encoding per push is the dominant cost. On a
+        # zero-copy in-process transport this is a by-reference handoff
+        # (no encode at all — communication.model_payload).
+        init_payload = node.communication.model_payload(node.learner.get_model())
         node.communication.gossip_weights(
             early_stopping_fn=lambda: check_early_stop(node),
             get_candidates_fn=candidates,
@@ -361,7 +363,7 @@ class TrainStage(Stage):
                     hit = (None, None, 0)
                 else:
                     hit = (
-                        model.encode_parameters(),
+                        node.communication.model_payload(model),
                         model.get_contributors(),
                         model.get_num_samples(),
                     )
@@ -646,8 +648,8 @@ class GossipModelStage(Stage):
                     contributors = [node.addr]
                 if base is not None:
                     try:
-                        payload = model.encode_parameters(
-                            delta_base=(st.round - 1, base[0], base[1])
+                        payload = node.communication.model_payload(
+                            model, delta_base=(st.round - 1, base[0], base[1])
                         )
                     except Exception as e:
                         # Structure drift vs the base (e.g. mid-run
@@ -655,9 +657,9 @@ class GossipModelStage(Stage):
                         logger.debug(
                             node.addr, f"Delta encode failed, dense: {e}"
                         )
-                        payload = model.encode_parameters()
+                        payload = node.communication.model_payload(model)
                 else:
-                    payload = model.encode_parameters()
+                    payload = node.communication.model_payload(model)
                 hit = (payload, contributors, model.get_num_samples())
                 fullmodel_cache[key] = hit
             payload, contributors, num_samples = hit
