@@ -517,6 +517,125 @@ def _chaos_tier(extra: dict) -> None:
         extra["chaos_error"] = str(e)[:200]
 
 
+def _analysis_tier(extra: dict) -> None:
+    """Analysis tier (tools/tpflcheck + tpfl.concurrency). Two reports:
+
+    - extra.analysis_static: wall-time of the full tpflcheck suite
+      (guards/locks/layers/knobs/threads/wire) over the tree — budget
+      < 5 s, zero unwaived violations.
+    - extra.analysis_lock_trace: the same seeded 3-node digits
+      federation run with Settings.LOCK_TRACING off and then on —
+      the traced run must finish with an ACYCLIC runtime acquisition
+      graph, every participating thread NAMED, and <10% round-
+      throughput overhead vs untraced.
+    """
+    import pathlib
+    import sys as _sys
+
+    root = pathlib.Path(__file__).resolve().parent
+    if str(root) not in _sys.path:
+        _sys.path.insert(0, str(root))
+    from tpfl.settings import Settings
+
+    try:
+        from tools.tpflcheck import run_all
+
+        t0 = time.monotonic()
+        violations, waived, warnings, _ = run_all(root)
+        wall = time.monotonic() - t0
+        extra["analysis_static"] = {
+            "wall_s": round(wall, 2),
+            "within_5s_budget": bool(wall < 5.0),
+            "violations": len(violations),
+            "waived": len(waived),
+            "warnings": len(warnings),
+        }
+
+        snap = Settings.snapshot()
+        try:
+            from tpfl.concurrency import lock_graph
+            from tpfl.management.logger import logger as _logger
+
+            Settings.set_test_settings()
+            Settings.LOG_LEVEL = "ERROR"
+            _logger.set_level("ERROR")
+            Settings.ELECTION = "hash"  # n <= TRAIN_SET_SIZE: all elected
+            Settings.SEED = 777
+
+            def run(traced: bool, tag: str) -> dict:
+                from tpfl.learning.dataset import (
+                    RandomIIDPartitionStrategy,
+                    synthetic_mnist,
+                )
+                from tpfl.models import create_model
+                from tpfl.node import Node
+                from tpfl.utils import wait_convergence, wait_to_finish
+
+                # Read at lock CREATION time: set before Node() builds
+                # its state/protocol/aggregator locks.
+                Settings.LOCK_TRACING = traced
+                lock_graph.clear()
+                n, rounds = 3, 4
+                ds = synthetic_mnist(n_train=150 * n, n_test=30, seed=0, noise=0.6)
+                parts = ds.generate_partitions(
+                    n, RandomIIDPartitionStrategy, seed=1
+                )
+                nodes = [
+                    Node(
+                        create_model("mlp", (28, 28), seed=7, hidden_sizes=(32,)),
+                        parts[i],
+                        addr=f"{tag}-{i}",  # pinned: seeded data order
+                        learning_rate=0.05,
+                        batch_size=32,
+                    )
+                    for i in range(n)
+                ]
+                for nd in nodes:
+                    nd.start()
+                try:
+                    for nd in nodes[1:]:
+                        nodes[0].connect(nd.addr)
+                    wait_convergence(nodes, n - 1, only_direct=False, wait=10)
+                    t0 = time.monotonic()
+                    nodes[0].set_start_learning(rounds=rounds, epochs=1)
+                    wait_to_finish(nodes, timeout=240)
+                    elapsed = time.monotonic() - t0
+                finally:
+                    for nd in nodes:
+                        nd.stop()  # traced runs assert acyclicity here
+                out = {
+                    "rounds": rounds,
+                    "elapsed_s": round(elapsed, 2),
+                    "rounds_per_s": round(rounds / elapsed, 3),
+                }
+                if traced:
+                    lock_graph.assert_acyclic()
+                    names = sorted(lock_graph.thread_names())
+                    out["acyclic"] = True
+                    out["runtime_edges"] = len(lock_graph.edges())
+                    out["traced_threads"] = len(names)
+                    out["all_threads_named"] = not any(
+                        t.startswith("Thread-") for t in names
+                    )
+                    out["thread_roster"] = names[:16]
+                return out
+
+            run(False, "lt-warm")  # discarded: pays the jit warmup
+            off = run(False, "lt-off")
+            on = run(True, "lt-on")
+            overhead = 1.0 - on["rounds_per_s"] / max(off["rounds_per_s"], 1e-9)
+            extra["analysis_lock_trace"] = {
+                "untraced": off,
+                "traced": on,
+                "overhead_frac": round(overhead, 4),
+                "within_10pct_budget": bool(overhead < 0.10),
+            }
+        finally:
+            Settings.restore(snap)
+    except Exception as e:
+        extra["analysis_error"] = str(e)[:200]
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument(
@@ -1157,6 +1276,10 @@ def main() -> None:
     # Chaos tier: deterministic fault accounting + live faulted A/B
     # (extra.chaos_determinism / extra.chaos_ab).
     _chaos_tier(extra)
+
+    # Analysis tier: tpflcheck suite wall-time + lock-traced federation
+    # A/B (extra.analysis_static / extra.analysis_lock_trace).
+    _analysis_tier(extra)
 
     # Only quantitative anchor in the reference: 2-round MNIST e2e must
     # fit in 240 s (node_test.py:105) -> 0.00833 rounds/s floor.

@@ -1,0 +1,84 @@
+"""tpflcheck — tpfl's static concurrency & invariant analysis suite.
+
+Grown out of ``tools/wirecheck.py`` (which remains as a shim): one
+framework, shared file-walking / waiver / reporting machinery
+(``core.py``), seven checks::
+
+    guards    guarded-by race lint (# guarded-by: annotations)
+    locks     static lock-order extraction + deadlock (cycle) detection
+    layers    SURVEY layer map (no upward module-level imports)
+    knobs     Settings knob existence / profile totality / docs sync
+    threads   thread-lifecycle hygiene (name= + daemon= everywhere)
+    wire      codec-registry, copy-discipline and RPC-path lints
+              (the original wirecheck trio)
+
+Run: ``python -m tools.tpflcheck`` (exit 1 on any unwaived violation).
+Waivers are data in ``pyproject.toml`` (``[tool.tpflcheck]``), each
+with a mandatory reason. The runtime counterpart of the ``locks``
+check is ``Settings.LOCK_TRACING`` (``tpfl.concurrency``). See
+docs/concurrency.md.
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+from tools.tpflcheck import wire
+from tools.tpflcheck.core import (
+    Violation,
+    Waivers,
+    apply_waivers,
+    load_waivers,
+    repo_root,
+)
+from tools.tpflcheck.guards import check_guards
+from tools.tpflcheck.knobs import check_knobs
+from tools.tpflcheck.layers import check_layers
+from tools.tpflcheck.locks import check_locks, lock_edges
+from tools.tpflcheck.threads import check_threads
+
+__all__ = [
+    "Violation",
+    "Waivers",
+    "check_guards",
+    "check_knobs",
+    "check_layers",
+    "check_locks",
+    "check_threads",
+    "lock_edges",
+    "run_all",
+    "wire",
+]
+
+
+def run_all(
+    repo: "pathlib.Path | None" = None,
+) -> "tuple[list[Violation], list[str], list[str], Waivers]":
+    """Run every check. Returns (violations-after-waivers, waived
+    descriptions, warnings, waivers)."""
+    root = repo_root(repo)
+    violations: list[Violation] = []
+    violations += check_guards(root)
+    violations += check_locks(root)
+    violations += check_layers(root)
+    knob_violations, warnings = check_knobs(root)
+    violations += knob_violations
+    violations += check_threads(root)
+    violations += wire.violations(root)
+
+    waivers = load_waivers(root)
+    kept, waived = apply_waivers(violations, waivers)
+    # A waiver without a reason is itself a failure — the list is
+    # reviewable data, and "because it's waived" is not a review.
+    for entry in waivers.unexplained:
+        kept.append(
+            Violation(
+                "waivers", "pyproject.toml", 0,
+                f"waiver without a reason: {entry!r} (format: "
+                '"<key> = <reason>")',
+                f"waivers:{entry}",
+            )
+        )
+    for key in waivers.unused():
+        warnings.append(f"stale waiver (matches nothing): {key}")
+    return kept, waived, warnings, waivers

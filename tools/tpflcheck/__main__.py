@@ -1,0 +1,53 @@
+"""``python -m tools.tpflcheck`` — run the full suite, exit 1 on any
+unwaived violation. ``-v`` also prints waived findings and the static
+lock-order edge list (the input to docs/concurrency.md's canonical
+order)."""
+
+from __future__ import annotations
+
+import sys
+import time
+
+from tools.tpflcheck import lock_edges, run_all
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    args = sys.argv[1:] if argv is None else argv
+    verbose = "-v" in args or "--verbose" in args
+    t0 = time.monotonic()
+    violations, waived, warnings, _ = run_all()
+    elapsed = time.monotonic() - t0
+
+    for v in violations:
+        print(v.render(), file=sys.stderr)
+    if verbose:
+        for w in waived:
+            print(f"waived: {w}")
+        print("\nstatic lock-order edges:")
+        seen = set()
+        for e in lock_edges():
+            key = (e.src, e.dst)
+            if key in seen:
+                continue
+            seen.add(key)
+            via = f" via {e.via}" if e.via else ""
+            print(f"  {e.src} -> {e.dst}  ({e.file}:{e.line}{via})")
+    for w in warnings:
+        print(f"warning: {w}")
+
+    if violations:
+        print(
+            f"tpflcheck FAILED — {len(violations)} violation(s), "
+            f"{len(waived)} waived ({elapsed:.2f}s)",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"tpflcheck OK — all checks passed, {len(waived)} waived "
+        f"finding(s), {len(warnings)} warning(s) ({elapsed:.2f}s)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

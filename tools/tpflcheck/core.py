@@ -1,0 +1,150 @@
+"""Shared machinery for the tpflcheck static-analysis suite.
+
+Every check produces :class:`Violation` records over the same file walk
+(:func:`py_files`), and waivers live as reviewable DATA in
+``pyproject.toml`` (``[tool.tpflcheck] waivers``) rather than code
+edits — a waiver is ``"<key> = <reason>"`` and a reason is mandatory:
+the suite fails on waivers without one ("zero unexplained waivers"),
+and warns about waivers that no longer match anything so the list
+cannot rot.
+
+Waiver keys are what each check reports in its violation output, e.g.::
+
+    guards:tpfl/learning/aggregators/aggregator.py::Aggregator._covered_meets_quorum::_train_set
+
+A waiver may also end with ``::*`` to waive every attribute in a
+function (``guards:<file>::<qualname>::*``) — used for helpers whose
+docstring already states "caller holds the lock".
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+
+@dataclass
+class Violation:
+    check: str  # "guards" | "locks" | "layers" | "knobs" | "threads" | "wire" ...
+    file: str  # repo-relative posix path ("" for repo-wide findings)
+    line: int
+    message: str
+    key: str  # what a waiver must match
+
+    def render(self) -> str:
+        loc = f"{self.file}:{self.line}" if self.file else "(repo)"
+        return f"[{self.check}] {loc}: {self.message}"
+
+
+def repo_root(explicit: "pathlib.Path | None" = None) -> pathlib.Path:
+    if explicit is not None:
+        return pathlib.Path(explicit)
+    return pathlib.Path(__file__).resolve().parent.parent.parent
+
+
+def py_files(
+    root: pathlib.Path, subdir: str = "tpfl"
+) -> list[pathlib.Path]:
+    return sorted(
+        p
+        for p in (root / subdir).rglob("*.py")
+        if "__pycache__" not in p.parts
+    )
+
+
+def rel(root: pathlib.Path, path: pathlib.Path) -> str:
+    return path.relative_to(root).as_posix()
+
+
+# --- waivers --------------------------------------------------------------
+
+_SECTION_RE = re.compile(r"^\[tool\.tpflcheck\]\s*$")
+_ANY_SECTION_RE = re.compile(r"^\[[^\]]+\]\s*$")
+_STRING_RE = re.compile(r'"((?:[^"\\]|\\.)*)"')
+
+
+@dataclass
+class Waivers:
+    """key -> reason, plus bookkeeping for unused/unexplained checks."""
+
+    reasons: dict[str, str] = field(default_factory=dict)
+    unexplained: list[str] = field(default_factory=list)  # entries w/o reason
+    _used: set[str] = field(default_factory=set)
+
+    def match(self, key: str) -> Optional[str]:
+        """Reason when ``key`` is waived (exact, or function-wide via a
+        ``::*`` suffix entry), else None. Marks the waiver used."""
+        reason = self.reasons.get(key)
+        if reason is not None:
+            self._used.add(key)
+            return reason
+        # guards:<file>::<qualname>::<attr> -> try guards:<file>::<qualname>::*
+        if "::" in key:
+            wide = key.rsplit("::", 1)[0] + "::*"
+            reason = self.reasons.get(wide)
+            if reason is not None:
+                self._used.add(wide)
+                return reason
+        return None
+
+    def unused(self) -> list[str]:
+        return sorted(set(self.reasons) - self._used)
+
+
+def load_waivers(root: pathlib.Path) -> Waivers:
+    """Parse ``[tool.tpflcheck] waivers`` from pyproject.toml.
+
+    Python 3.10 has no ``tomllib``; the section only needs an array of
+    strings, so a line parser suffices (and keeps the checker
+    dependency-free). Each entry is ``"<key> = <reason>"``."""
+    w = Waivers()
+    pyproject = root / "pyproject.toml"
+    if not pyproject.exists():
+        return w
+    in_section = in_array = False
+    for raw in pyproject.read_text(encoding="utf-8").splitlines():
+        line = raw.strip()
+        if _SECTION_RE.match(line):
+            in_section = True
+            continue
+        if in_section and _ANY_SECTION_RE.match(line):
+            break  # next section
+        if not in_section:
+            continue
+        if line.startswith("waivers"):
+            in_array = "[" in line and "]" not in line.split("#", 1)[0]
+            for entry in _STRING_RE.findall(line):
+                _add_waiver(w, entry)
+            continue
+        if in_array:
+            for entry in _STRING_RE.findall(line):
+                _add_waiver(w, entry)
+            if "]" in line.split("#", 1)[0]:
+                in_array = False
+    return w
+
+
+def _add_waiver(w: Waivers, entry: str) -> None:
+    key, sep, reason = entry.partition(" = ")
+    key, reason = key.strip(), reason.strip()
+    if not sep or not reason:
+        w.unexplained.append(entry)
+        return
+    w.reasons[key] = reason
+
+
+def apply_waivers(
+    violations: Iterable[Violation], waivers: Waivers
+) -> tuple[list[Violation], list[str]]:
+    """Split into (kept, waived-descriptions)."""
+    kept: list[Violation] = []
+    waived: list[str] = []
+    for v in violations:
+        reason = waivers.match(v.key)
+        if reason is None:
+            kept.append(v)
+        else:
+            waived.append(f"{v.key}  (waived: {reason})")
+    return kept, waived

@@ -1,0 +1,316 @@
+"""Guarded-by race lint.
+
+The threaded core's shared fields are declared with source annotations
+(on the assignment line in ``__init__``, or the comment line directly
+above it)::
+
+    # guarded-by: models_aggregated_lock
+    self.models_aggregated: dict[str, list[str]] = {}
+
+    # unguarded: replaced wholesale by the learning thread; readers
+    # iterate whichever snapshot reference they loaded.
+    self.train_set: list[str] = []
+
+Grammar:
+
+- ``# guarded-by: <lock>`` — every read/write of the attribute,
+  ANYWHERE under ``tpfl/``, must sit lexically inside a
+  ``with <...>.<lock>:`` block in the same function scope.
+- ``# guarded-by: <lock> writes`` — only writes are checked; lock-free
+  reads are declared tolerable (monotonic watermarks, cache keys whose
+  staleness is benign). The write sites are the read-modify-writes
+  that actually lose updates.
+- ``# unguarded: <reason>`` — explicitly waived at the declaration,
+  with a mandatory reason (GIL-atomic reference swaps, internally
+  synchronized objects).
+
+Two passes over :data:`GUARDED_MODULES` (the modules owning the
+cross-thread state — NodeState, Gossiper, Neighbors, CircuitBreaker,
+BufferPool, the metric stores, the Aggregator):
+
+1. **Completeness** — every attribute initialized in ``__init__`` with
+   a mutable container (dict/list/set/deque literal or constructor)
+   must carry an annotation. New shared state cannot be added
+   unannotated.
+2. **Access** — every access to a guarded attribute, across ALL of
+   ``tpfl/`` (the expected true positives historically lived in
+   ``stages/base_node.py``, not in the owning module), is checked for
+   an enclosing ``with`` on the declared lock. Helpers that run under
+   the caller's lock are waived in ``pyproject.toml``
+   (``guards:<file>::<qualname>::*``) with the reason in the data.
+
+Lexical containment deliberately does NOT cross function boundaries: a
+closure defined inside a ``with`` block but called later is not
+protected by it, so the lint treats it as unguarded.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+from dataclasses import dataclass
+
+from tools.tpflcheck.core import Violation, py_files, rel, repo_root
+
+#: The modules whose classes own cross-thread mutable state.
+GUARDED_MODULES = (
+    "tpfl/node_state.py",
+    "tpfl/communication/gossiper.py",
+    "tpfl/communication/neighbors.py",
+    "tpfl/communication/resilience.py",
+    "tpfl/learning/bufferpool.py",
+    "tpfl/management/metric_storage.py",
+    "tpfl/learning/aggregators/aggregator.py",
+)
+
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)(\s+writes)?")
+_UNGUARDED_RE = re.compile(r"#\s*unguarded:\s*(\S.*)?$")
+
+_MUTABLE_CTORS = {"dict", "list", "set", "deque", "defaultdict", "OrderedDict"}
+_LOCKISH_CTORS = {
+    "Lock", "RLock", "Condition", "Event", "Semaphore", "make_lock",
+    "TracedLock",
+}
+
+
+@dataclass
+class GuardDecl:
+    module: str  # repo-relative path of the owning module
+    cls: str
+    attr: str
+    lock: "str | None"  # None => unguarded (annotated waiver)
+    writes_only: bool
+    reason: "str | None"
+    line: int
+
+
+def _annotation_for(lines: list[str], lineno: int) -> "tuple[str, str, bool] | None":
+    """Look for a guard annotation on ``lineno`` (1-based) or in the
+    contiguous comment block directly above it. Returns
+    (kind, payload, writes_only) where kind is 'guarded'/'unguarded'."""
+    candidates = [lines[lineno - 1]]
+    i = lineno - 2
+    while i >= 0 and lines[i].strip().startswith("#"):
+        candidates.append(lines[i])
+        i -= 1
+    for text in candidates:
+        m = _GUARDED_RE.search(text)
+        if m:
+            return ("guarded", m.group(1), bool(m.group(2)))
+        m = _UNGUARDED_RE.search(text)
+        if m:
+            return ("unguarded", (m.group(1) or "").strip(), False)
+    return None
+
+
+def _is_mutable_init(value: ast.expr) -> bool:
+    if isinstance(value, (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                          ast.ListComp, ast.SetComp)):
+        return True
+    if isinstance(value, ast.Call):
+        fn = value.func
+        name = fn.id if isinstance(fn, ast.Name) else (
+            fn.attr if isinstance(fn, ast.Attribute) else ""
+        )
+        return name in _MUTABLE_CTORS
+    return False
+
+
+def _is_lockish_init(value: ast.expr) -> bool:
+    if isinstance(value, ast.Call):
+        fn = value.func
+        name = fn.id if isinstance(fn, ast.Name) else (
+            fn.attr if isinstance(fn, ast.Attribute) else ""
+        )
+        return name in _LOCKISH_CTORS
+    return False
+
+
+def collect_decls(
+    root: pathlib.Path,
+) -> "tuple[list[GuardDecl], list[Violation]]":
+    """Parse annotations out of the guarded modules; also run the
+    completeness pass (unannotated mutable ``__init__`` attributes)."""
+    decls: list[GuardDecl] = []
+    violations: list[Violation] = []
+    for module in GUARDED_MODULES:
+        path = root / module
+        if not path.exists():
+            continue
+        src = path.read_text(encoding="utf-8")
+        lines = src.splitlines()
+        tree = ast.parse(src)
+        for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+            init = next(
+                (
+                    f
+                    for f in cls.body
+                    if isinstance(f, ast.FunctionDef) and f.name == "__init__"
+                ),
+                None,
+            )
+            if init is None:
+                continue
+            for stmt in ast.walk(init):
+                if isinstance(stmt, ast.Assign):
+                    targets, value = stmt.targets, stmt.value
+                elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                    targets, value = [stmt.target], stmt.value
+                else:
+                    continue
+                for t in targets:
+                    if not (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        continue
+                    ann = _annotation_for(lines, stmt.lineno)
+                    if ann is not None:
+                        kind, payload, writes_only = ann
+                        if kind == "guarded":
+                            decls.append(
+                                GuardDecl(
+                                    module, cls.name, t.attr, payload,
+                                    writes_only, None, stmt.lineno,
+                                )
+                            )
+                        else:
+                            if not payload:
+                                violations.append(
+                                    Violation(
+                                        "guards", module, stmt.lineno,
+                                        f"{cls.name}.{t.attr}: '# unguarded:' "
+                                        "annotation requires a reason",
+                                        f"guards:{module}::{cls.name}.{t.attr}"
+                                        "::reason",
+                                    )
+                                )
+                            decls.append(
+                                GuardDecl(
+                                    module, cls.name, t.attr, None, False,
+                                    payload or None, stmt.lineno,
+                                )
+                            )
+                    elif _is_mutable_init(value) and not _is_lockish_init(value):
+                        violations.append(
+                            Violation(
+                                "guards", module, stmt.lineno,
+                                f"{cls.name}.{t.attr}: mutable attribute "
+                                "without a '# guarded-by:' / "
+                                "'# unguarded:' annotation",
+                                f"guards:{module}::{cls.name}.{t.attr}"
+                                "::unannotated",
+                            )
+                        )
+    return decls, violations
+
+
+class _AccessChecker(ast.NodeVisitor):
+    """Walk one file tracking (qualname scope, held-lock with-stack)."""
+
+    def __init__(
+        self,
+        relpath: str,
+        guarded: dict[str, list[GuardDecl]],
+        violations: list[Violation],
+    ) -> None:
+        self.relpath = relpath
+        self.guarded = guarded
+        self.violations = violations
+        self.scope: list[str] = []
+        # With-held lock attr names, per function scope depth.
+        self.held: list[set[str]] = [set()]
+
+    # --- scope tracking ---
+
+    def _enter_fn(self, node: "ast.FunctionDef | ast.AsyncFunctionDef | ast.Lambda") -> None:
+        name = getattr(node, "name", "<lambda>")
+        self.scope.append(name)
+        self.held.append(set())  # a with outside the fn doesn't protect it
+        for child in ast.iter_child_nodes(node):
+            self.visit(child)
+        self.held.pop()
+        self.scope.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._enter_fn(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._enter_fn(node)
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self._enter_fn(node)
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.scope.append(node.name)
+        self.generic_visit(node)
+        self.scope.pop()
+
+    def visit_With(self, node: ast.With) -> None:
+        names = set()
+        for item in node.items:
+            expr = item.context_expr
+            if isinstance(expr, ast.Attribute):
+                names.add(expr.attr)
+            elif isinstance(expr, ast.Name):
+                names.add(expr.id)
+            # The with-item expression itself is OUTSIDE the lock.
+            self.visit(expr)
+        self.held[-1] |= names
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held[-1] -= names
+
+    # --- the check ---
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        decls = self.guarded.get(node.attr)
+        if decls:
+            is_write = not isinstance(node.ctx, ast.Load)
+            applicable = [
+                d for d in decls if is_write or not d.writes_only
+            ]
+            if applicable:
+                locks = {d.lock for d in applicable}
+                if not (locks & self.held[-1]):
+                    qual = ".".join(self.scope) or "<module>"
+                    owner = applicable[0]
+                    # Auto-exempt the declaring __init__ of ANY owning
+                    # class (the object is not shared until the
+                    # constructor returns).
+                    in_owner_init = (
+                        self.scope
+                        and self.scope[-1] == "__init__"
+                        and any(
+                            self.relpath == d.module and d.cls in self.scope
+                            for d in applicable
+                        )
+                    )
+                    if not in_owner_init:
+                        kind = "write" if is_write else "read"
+                        self.violations.append(
+                            Violation(
+                                "guards", self.relpath, node.lineno,
+                                f"{kind} of {owner.cls}.{node.attr} "
+                                f"(guarded by {sorted(locks)[0]}) outside "
+                                f"'with {sorted(locks)[0]}:' in {qual}",
+                                f"guards:{self.relpath}::{qual}::{node.attr}",
+                            )
+                        )
+        self.generic_visit(node)
+
+
+def check_guards(repo: "pathlib.Path | None" = None) -> list[Violation]:
+    root = repo_root(repo)
+    decls, violations = collect_decls(root)
+    guarded: dict[str, list[GuardDecl]] = {}
+    for d in decls:
+        if d.lock is not None:
+            guarded.setdefault(d.attr, []).append(d)
+    for path in py_files(root):
+        r = rel(root, path)
+        tree = ast.parse(path.read_text(encoding="utf-8"))
+        _AccessChecker(r, guarded, violations).visit(tree)
+    return violations

@@ -1,0 +1,150 @@
+"""Architecture lint: the SURVEY layer map, enforced.
+
+tpfl's layering (SURVEY §1, mirrored from the reference's):
+
+    settings → management → communication → learning → parallel →
+    models → simulation → stages → node/node_state → utils →
+    attacks/interop → examples/cli
+
+A module may import its own layer or anything BELOW it; an upward
+module-level import is a violation. Two escape hatches are legal and
+deliberately NOT flagged:
+
+- ``if TYPE_CHECKING:`` imports (annotations only, no runtime edge) —
+  how stages/commands name ``Node`` without depending on it;
+- function-level imports (lazy seams, e.g. ``commands.py`` reaching
+  into ``tpfl.learning.compression`` inside a handler) — a runtime
+  edge, but one whose cost and cycle-safety the author chose
+  explicitly. The lint pins the *static import graph*, which is what
+  determines import-time cycles and layer erosion.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+
+from tools.tpflcheck.core import Violation, py_files, rel, repo_root
+
+#: Component -> layer number. A component is the first path element
+#: under ``tpfl/`` (package dir or module stem).
+LAYERS: dict[str, int] = {
+    # foundations: stdlib-only (settings/exceptions/experiment) or
+    # settings-only (concurrency)
+    "__init__": 0,
+    "settings": 0,
+    "exceptions": 0,
+    "experiment": 0,
+    "concurrency": 0,
+    "management": 1,
+    "communication": 2,
+    "learning": 3,
+    "parallel": 4,
+    "models": 5,
+    "simulation": 6,
+    "stages": 7,
+    "node": 8,
+    "node_state": 8,
+    "utils": 9,
+    "attacks": 10,
+    "interop": 10,
+    "examples": 11,
+    "cli": 11,
+}
+
+
+def _component(module: str) -> "str | None":
+    """'tpfl.communication.base' -> 'communication'; 'tpfl' -> '__init__'."""
+    parts = module.split(".")
+    if parts[0] != "tpfl":
+        return None
+    return parts[1] if len(parts) > 1 else "__init__"
+
+
+def _file_component(relpath: str) -> "str | None":
+    parts = pathlib.PurePosixPath(relpath).parts
+    if parts[0] != "tpfl":
+        return None
+    if len(parts) == 2:
+        return pathlib.PurePosixPath(parts[1]).stem
+    return parts[1]
+
+
+def _module_level_imports(tree: ast.Module) -> "list[tuple[str, int]]":
+    """(module, lineno) for every import that creates a runtime edge at
+    import time: module body plus try/if bodies at module level, but
+    NOT ``if TYPE_CHECKING:`` bodies and NOT function/class bodies
+    below method level."""
+    out: list[tuple[str, int]] = []
+
+    def is_type_checking(test: ast.expr) -> bool:
+        return (isinstance(test, ast.Name) and test.id == "TYPE_CHECKING") or (
+            isinstance(test, ast.Attribute) and test.attr == "TYPE_CHECKING"
+        )
+
+    def walk(stmts: list[ast.stmt]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, ast.Import):
+                out.extend((a.name, stmt.lineno) for a in stmt.names)
+            elif isinstance(stmt, ast.ImportFrom):
+                if stmt.module and stmt.level == 0:
+                    out.append((stmt.module, stmt.lineno))
+            elif isinstance(stmt, ast.If):
+                if not is_type_checking(stmt.test):
+                    walk(stmt.body)
+                walk(stmt.orelse)
+            elif isinstance(stmt, ast.Try):
+                walk(stmt.body)
+                for h in stmt.handlers:
+                    walk(h.body)
+                walk(stmt.orelse)
+                walk(stmt.finalbody)
+
+    walk(tree.body)
+    return out
+
+
+def check_layers(repo: "pathlib.Path | None" = None) -> list[Violation]:
+    root = repo_root(repo)
+    violations: list[Violation] = []
+    for path in py_files(root):
+        r = rel(root, path)
+        comp = _file_component(r)
+        if comp is None or comp not in LAYERS:
+            violations.append(
+                Violation(
+                    "layers", r, 1,
+                    f"component {comp!r} is not in the layer map "
+                    "(add it to tools/tpflcheck/layers.py LAYERS)",
+                    f"layers:{r}::unmapped",
+                )
+            )
+            continue
+        my_layer = LAYERS[comp]
+        tree = ast.parse(path.read_text(encoding="utf-8"))
+        for module, lineno in _module_level_imports(tree):
+            target = _component(module)
+            if target is None:
+                continue  # third-party / stdlib
+            target_layer = LAYERS.get(target)
+            if target_layer is None:
+                violations.append(
+                    Violation(
+                        "layers", r, lineno,
+                        f"import of unmapped component {module!r}",
+                        f"layers:{r}::{module}",
+                    )
+                )
+            elif target_layer > my_layer:
+                violations.append(
+                    Violation(
+                        "layers", r, lineno,
+                        f"upward import: {comp} (layer {my_layer}) "
+                        f"imports {module} (layer {target_layer}) — "
+                        "move the dependency down, invert it via a "
+                        "callback, or make it a TYPE_CHECKING/"
+                        "function-level seam",
+                        f"layers:{r}::{module}",
+                    )
+                )
+    return violations

@@ -1,0 +1,352 @@
+"""Static lock-order / deadlock lint.
+
+Extracts the lock-acquisition graph from the source of ``tpfl/`` and
+fails on cycles — a cycle means two code paths can acquire the same
+pair of locks in opposite orders, which deadlocks under the right
+interleaving.
+
+What counts as a lock: any attribute / module-level name ending in
+``lock`` (the repo's universal naming convention, enforced de facto by
+``tpfl.concurrency.make_lock``). Lock IDENTITY is class-qualified
+(``Neighbors._lock``), so all instances of a class share a node —
+two *different* peer tables locked in opposite orders by two threads
+deadlock just as surely as one.
+
+Edges come from two sources:
+
+1. **Nested ``with``** inside one function: holding A while entering
+   ``with B:`` adds A→B.
+2. **Calls under a held lock**, resolved one level deep with light,
+   high-precision type inference: ``self.m()`` resolves within the
+   class; ``self.attr.m()`` resolves through ``self.attr = Class(...)``
+   assignments in ``__init__``; bare ``f()`` resolves to same-module
+   functions. Every lock the callee acquires becomes an edge from each
+   held lock. Callbacks and dynamically dispatched sends do NOT
+   resolve — that blind spot is exactly what the runtime half covers
+   (``Settings.LOCK_TRACING`` + ``tpfl.concurrency.TracedLock``, whose
+   graph ``Node.stop`` asserts acyclic).
+
+The edge list doubles as documentation: docs/concurrency.md's
+"canonical lock order" section is the topological order of this graph.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+from dataclasses import dataclass, field
+
+from tools.tpflcheck.core import Violation, py_files, rel, repo_root
+
+
+def _is_lock_name(name: str) -> bool:
+    # The repo convention: every lock attribute/name ends in "_lock"
+    # (never bare suffix matching — "block"/"clock" are not locks).
+    return name.endswith("_lock") or name == "lock"
+
+
+@dataclass
+class _Scope:
+    module: str  # repo-relative path
+    modbase: str  # module basename, for module-level lock identities
+    cls: "str | None" = None
+    attr_types: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Edge:
+    src: str
+    dst: str
+    file: str
+    line: int
+    via: str  # "" for nested-with, else the resolved callee
+
+
+class _ModuleIndex:
+    """First pass: classes, their lock attrs, attr types, methods."""
+
+    def __init__(self) -> None:
+        # class name -> module relpath (assumes unique class names,
+        # true in tpfl and asserted loudly below if it breaks)
+        self.class_module: dict[str, str] = {}
+        # class -> {attr -> ClassName} from `self.attr = Class(...)`
+        self.attr_types: dict[str, dict[str, str]] = {}
+        # class -> set of lock attr names defined on it
+        self.class_locks: dict[str, set[str]] = {}
+        # (class|None, func) per module -> FunctionDef for callee summaries
+        self.functions: dict[tuple[str, "str | None", str], ast.AST] = {}
+        # known class names (for attr-type inference)
+        self.known_classes: set[str] = set()
+
+    def build(self, root: pathlib.Path) -> None:
+        for path in py_files(root):
+            r = rel(root, path)
+            tree = ast.parse(path.read_text(encoding="utf-8"))
+            for node in tree.body:
+                if isinstance(node, ast.ClassDef):
+                    self.known_classes.add(node.name)
+                    self.class_module.setdefault(node.name, r)
+                    for sub in node.body:
+                        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                            self.functions[(r, node.name, sub.name)] = sub
+                        # class-body lock fields (dataclass fields,
+                        # class-level locks like _instance_lock)
+                        tgt = None
+                        if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                            tgt = sub.targets[0]
+                        elif isinstance(sub, ast.AnnAssign):
+                            tgt = sub.target
+                        if isinstance(tgt, ast.Name) and _is_lock_name(tgt.id):
+                            self.class_locks.setdefault(node.name, set()).add(
+                                tgt.id
+                            )
+                elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self.functions[(r, None, node.name)] = node
+            # self.attr assignments inside methods: lock attrs + types
+            for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+                for stmt in ast.walk(cls):
+                    tgt = None
+                    value = None
+                    if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                        tgt, value = stmt.targets[0], stmt.value
+                    elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                        tgt, value = stmt.target, stmt.value
+                    if not (
+                        isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id in ("self", "cls")
+                    ):
+                        continue
+                    if _is_lock_name(tgt.attr):
+                        self.class_locks.setdefault(cls.name, set()).add(tgt.attr)
+                    if isinstance(value, ast.Call):
+                        fn = value.func
+                        cname = (
+                            fn.id
+                            if isinstance(fn, ast.Name)
+                            else fn.attr if isinstance(fn, ast.Attribute) else ""
+                        )
+                        if cname in self.known_classes or cname[:1].isupper():
+                            self.attr_types.setdefault(cls.name, {})[
+                                tgt.attr
+                            ] = cname
+
+    def lock_owner(self, attr: str) -> "str | None":
+        """Class that (uniquely) defines lock attribute ``attr``."""
+        owners = [c for c, locks in self.class_locks.items() if attr in locks]
+        return owners[0] if len(owners) == 1 else None
+
+
+def _lock_id(expr: ast.expr, scope: _Scope, index: _ModuleIndex) -> "str | None":
+    """Identity of a with-item lock expression, or None if not a lock."""
+    if isinstance(expr, ast.Name):
+        if not _is_lock_name(expr.id):
+            return None
+        return f"{scope.modbase}.{expr.id}"
+    if isinstance(expr, ast.Attribute):
+        if not _is_lock_name(expr.attr):
+            return None
+        base = expr.value
+        if isinstance(base, ast.Name) and base.id in ("self", "cls"):
+            if scope.cls is not None:
+                return f"{scope.cls}.{expr.attr}"
+            return f"{scope.modbase}.{expr.attr}"
+        # Non-self base: resolve by unique defining class, else by the
+        # base's textual name (good enough for module-level singletons).
+        owner = index.lock_owner(expr.attr)
+        if owner is not None:
+            return f"{owner}.{expr.attr}"
+        basename = base.id if isinstance(base, ast.Name) else "?"
+        return f"{scope.modbase}.{basename}.{expr.attr}"
+    return None
+
+
+def _callee_key(
+    call: ast.Call, scope: _Scope, index: _ModuleIndex
+) -> "tuple[str, str | None, str] | None":
+    """Resolve a call to a (module, class, func) key in the index."""
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        key = (scope.module, None, fn.id)
+        return key if key in index.functions else None
+    if not isinstance(fn, ast.Attribute):
+        return None
+    base = fn.value
+    if isinstance(base, ast.Name) and base.id in ("self", "cls"):
+        if scope.cls is None:
+            return None
+        key = (scope.module, scope.cls, fn.attr)
+        return key if key in index.functions else None
+    if (
+        isinstance(base, ast.Attribute)
+        and isinstance(base.value, ast.Name)
+        and base.value.id in ("self", "cls")
+        and scope.cls is not None
+    ):
+        # self.attr.m() via __init__-inferred attr type
+        cname = index.attr_types.get(scope.cls, {}).get(base.attr)
+        if cname is None:
+            return None
+        mod = index.class_module.get(cname)
+        if mod is None:
+            return None
+        key = (mod, cname, fn.attr)
+        return key if key in index.functions else None
+    return None
+
+
+def _locks_acquired(
+    fn_node: ast.AST, scope: _Scope, index: _ModuleIndex
+) -> set[str]:
+    """Every lock a function acquires anywhere in its own body
+    (one-level callee summary; not transitive)."""
+    acquired: set[str] = set()
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                lid = _lock_id(item.context_expr, scope, index)
+                if lid is not None:
+                    acquired.add(lid)
+    return acquired
+
+
+class _EdgeCollector(ast.NodeVisitor):
+    def __init__(
+        self, scope: _Scope, index: _ModuleIndex, edges: list[Edge],
+        summaries: dict[tuple[str, "str | None", str], set[str]],
+    ) -> None:
+        self.scope = scope
+        self.index = index
+        self.edges = edges
+        self.summaries = summaries
+        self.held: list[str] = []
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        prev = self.scope.cls
+        self.scope.cls = node.name
+        self.generic_visit(node)
+        self.scope.cls = prev
+
+    def _enter_fn(self, node: ast.AST) -> None:
+        # A with outside a nested function does not protect (or hold
+        # across) the function's later execution.
+        prev, self.held = self.held, []
+        for child in ast.iter_child_nodes(node):
+            self.visit(child)
+        self.held = prev
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._enter_fn(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._enter_fn(node)
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self._enter_fn(node)
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired: list[str] = []
+        for item in node.items:
+            self.visit(item.context_expr)
+            lid = _lock_id(item.context_expr, self.scope, self.index)
+            if lid is None:
+                continue
+            for held in self.held:
+                if held != lid:
+                    self.edges.append(
+                        Edge(held, lid, self.scope.module, node.lineno, "")
+                    )
+            self.held.append(lid)
+            acquired.append(lid)
+        for stmt in node.body:
+            self.visit(stmt)
+        for lid in reversed(acquired):
+            self.held.remove(lid)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.held:
+            key = _callee_key(node, self.scope, self.index)
+            if key is not None:
+                for lid in sorted(self.summaries.get(key, ())):
+                    for held in self.held:
+                        if held != lid:
+                            self.edges.append(
+                                Edge(
+                                    held, lid, self.scope.module,
+                                    node.lineno,
+                                    via=f"{key[1] or key[0]}.{key[2]}",
+                                )
+                            )
+        self.generic_visit(node)
+
+
+def lock_edges(repo: "pathlib.Path | None" = None) -> list[Edge]:
+    """The static lock-acquisition graph of ``tpfl/``."""
+    root = repo_root(repo)
+    index = _ModuleIndex()
+    index.build(root)
+    # Callee summaries: locks each indexed function acquires itself.
+    summaries: dict[tuple[str, "str | None", str], set[str]] = {}
+    for (mod, cls, name), fn_node in index.functions.items():
+        scope = _Scope(mod, pathlib.PurePosixPath(mod).stem, cls)
+        summaries[(mod, cls, name)] = _locks_acquired(fn_node, scope, index)
+    edges: list[Edge] = []
+    for path in py_files(root):
+        r = rel(root, path)
+        tree = ast.parse(path.read_text(encoding="utf-8"))
+        scope = _Scope(r, path.stem)
+        _EdgeCollector(scope, index, edges, summaries).visit(tree)
+    return edges
+
+
+def check_locks(repo: "pathlib.Path | None" = None) -> list[Violation]:
+    edges = lock_edges(repo)
+    adj: dict[str, set[str]] = {}
+    witness: dict[tuple[str, str], Edge] = {}
+    for e in edges:
+        adj.setdefault(e.src, set()).add(e.dst)
+        witness.setdefault((e.src, e.dst), e)
+
+    WHITE, GREY, BLACK = 0, 1, 2
+    color: dict[str, int] = {}
+    parent: dict[str, str] = {}
+    violations: list[Violation] = []
+
+    def dfs(u: str) -> "list[str] | None":
+        color[u] = GREY
+        for v in sorted(adj.get(u, ())):
+            c = color.get(v, WHITE)
+            if c == GREY:
+                chain = [u]
+                while chain[-1] != v:
+                    chain.append(parent[chain[-1]])
+                chain.reverse()
+                chain.append(v)
+                return chain
+            if c == WHITE:
+                parent[v] = u
+                found = dfs(v)
+                if found is not None:
+                    return found
+        color[u] = BLACK
+        return None
+
+    for node in sorted(adj):
+        if color.get(node, WHITE) == WHITE:
+            chain = dfs(node)
+            if chain is not None:
+                steps = []
+                for a, b in zip(chain, chain[1:]):
+                    e = witness[(a, b)]
+                    via = f" via {e.via}" if e.via else ""
+                    steps.append(f"{a} -> {b} ({e.file}:{e.line}{via})")
+                violations.append(
+                    Violation(
+                        "locks", "", 0,
+                        "lock acquisition cycle (latent deadlock): "
+                        + "; ".join(steps),
+                        "locks:cycle:" + "->".join(chain),
+                    )
+                )
+                break  # one witness cycle is enough to fail the build
+    return violations

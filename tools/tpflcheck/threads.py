@@ -1,0 +1,134 @@
+"""Thread-lifecycle lint.
+
+Every thread in ``tpfl/`` must be identifiable in a deadlock witness
+chain, a lock trace, or a py-spy dump — ``Thread-7`` is not a
+diagnosis. Three rules:
+
+- ``threading.Thread(...)`` call sites pass BOTH ``name=`` and
+  ``daemon=`` explicitly (daemon-ness is a shutdown-semantics decision
+  that should be visible at the creation site, not inherited);
+- classes subclassing ``threading.Thread`` pass ``name=`` and
+  ``daemon=`` through their ``super().__init__`` call;
+- ``ThreadPoolExecutor(...)`` passes ``thread_name_prefix=``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.tpflcheck.core import Violation, py_files, rel, repo_root
+
+
+def _call_name(call: ast.Call) -> str:
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return ""
+
+
+def _kwargs(call: ast.Call) -> set[str]:
+    return {k.arg for k in call.keywords if k.arg is not None}
+
+
+def _subclasses_thread(cls: ast.ClassDef) -> bool:
+    for base in cls.bases:
+        name = (
+            base.id
+            if isinstance(base, ast.Name)
+            else base.attr if isinstance(base, ast.Attribute) else ""
+        )
+        if name == "Thread":
+            return True
+    return False
+
+
+def check_threads(repo=None) -> list[Violation]:
+    root = repo_root(repo)
+    violations: list[Violation] = []
+    for path in py_files(root):
+        r = rel(root, path)
+        tree = ast.parse(path.read_text(encoding="utf-8"))
+
+        # Which Call nodes are super().__init__ inside Thread subclasses
+        # (those are checked by the subclass rule, not the call rule).
+        thread_subclass_inits: set[ast.Call] = set()
+        for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+            if not _subclasses_thread(cls):
+                continue
+            init = next(
+                (
+                    f
+                    for f in cls.body
+                    if isinstance(f, ast.FunctionDef) and f.name == "__init__"
+                ),
+                None,
+            )
+            if init is None:
+                violations.append(
+                    Violation(
+                        "threads", r, cls.lineno,
+                        f"{cls.name} subclasses Thread without an "
+                        "__init__ setting name=/daemon=",
+                        f"threads:{r}::{cls.name}",
+                    )
+                )
+                continue
+            super_init = None
+            for node in ast.walk(init):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "__init__"
+                ):
+                    super_init = node
+                    thread_subclass_inits.add(node)
+            if super_init is None:
+                violations.append(
+                    Violation(
+                        "threads", r, init.lineno,
+                        f"{cls.name}.__init__ never calls "
+                        "super().__init__ (thread gets a default name)",
+                        f"threads:{r}::{cls.name}",
+                    )
+                )
+            else:
+                missing = {"name", "daemon"} - _kwargs(super_init)
+                if missing:
+                    violations.append(
+                        Violation(
+                            "threads", r, super_init.lineno,
+                            f"{cls.name}'s super().__init__ is missing "
+                            f"{sorted(missing)} — traced-lock/deadlock "
+                            "reports would show 'Thread-N'",
+                            f"threads:{r}::{cls.name}",
+                        )
+                    )
+
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or node in thread_subclass_inits:
+                continue
+            name = _call_name(node)
+            if name == "Thread":
+                missing = {"name", "daemon"} - _kwargs(node)
+                if missing:
+                    violations.append(
+                        Violation(
+                            "threads", r, node.lineno,
+                            f"threading.Thread(...) without explicit "
+                            f"{sorted(missing)}",
+                            f"threads:{r}:{node.lineno}",
+                        )
+                    )
+            elif name == "ThreadPoolExecutor":
+                if "thread_name_prefix" not in _kwargs(node):
+                    violations.append(
+                        Violation(
+                            "threads", r, node.lineno,
+                            "ThreadPoolExecutor(...) without "
+                            "thread_name_prefix=",
+                            f"threads:{r}:{node.lineno}",
+                        )
+                    )
+    return violations

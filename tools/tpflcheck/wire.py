@@ -1,0 +1,178 @@
+"""Wire-path lints (moved from the original ``tools/wirecheck.py``).
+
+Three checks, unchanged in behavior, now sharing tpflcheck's walk and
+reporting machinery (``tools/wirecheck.py`` remains as a shim so the
+original entry point and test imports keep working):
+
+- :func:`check` — model payloads must go through the codec registry:
+  raw ``serialization.encode_pytree`` / ``encode_model_payload`` /
+  ``msgpack.packb`` outside the allowlisted modules bypasses the
+  versioned codec envelope (``tpfl/learning/compression.py``) — such
+  payloads never quantize, never delta-encode, and old/new peers can
+  silently stop agreeing on the wire format.
+- :func:`check_copies` — array bytes must not be copied outside the
+  serialization layer: a stray ``.tobytes()`` or
+  ``frombuffer(...).copy()`` reintroduces exactly the per-leaf memcpy
+  the v3 zero-copy layout removed, silently (payloads still
+  round-trip).
+- :func:`check_rpc` — no code outside the transport layer may invoke a
+  gRPC stub/channel or call ``_transport_send`` directly; every
+  outbound message must flow through
+  ``ThreadedCommunicationProtocol.send``, where retry/backoff, the
+  circuit breaker, the fault injector, and the send-health counters
+  live.
+
+Each returns ``['path:line: offending text', ...]`` (the legacy
+interface the test suite asserts on); :func:`violations` adapts all
+three to tpflcheck's :class:`~tools.tpflcheck.core.Violation` stream.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+
+from tools.tpflcheck.core import Violation, py_files, rel, repo_root
+
+ALLOWED = {
+    # the v1 envelope implementation
+    "tpfl/learning/serialization.py",
+    # the v2 codec implementation
+    "tpfl/learning/compression.py",
+    # encode_parameters — the registry dispatch itself (dense-vs-codec)
+    "tpfl/learning/model.py",
+    # transport framing (control fields + already-encoded payload bytes)
+    "tpfl/communication/message.py",
+    # RPC control frames and chunk frames around already-encoded bytes
+    "tpfl/communication/grpc_transport.py",
+    # on-DISK format, deliberately exact (never rides the wire)
+    "tpfl/management/checkpoint.py",
+}
+
+# Raw serialization entry points a wire path must not touch directly.
+PATTERN = re.compile(
+    r"(?<![\w.])(?:serialization\.)?(?:encode_pytree|encode_model_payload)\s*\("
+    r"|msgpack\.packb\s*\("
+)
+
+
+def check(repo: "pathlib.Path | None" = None) -> list[str]:
+    """Return a list of 'path:line: offending text' violations."""
+    root = repo_root(repo)
+    out: list[str] = []
+    for path in py_files(root):
+        r = rel(root, path)
+        if r in ALLOWED:
+            continue
+        for lineno, line in enumerate(
+            path.read_text(encoding="utf-8").splitlines(), 1
+        ):
+            stripped = line.split("#", 1)[0]
+            m = PATTERN.search(stripped)
+            if m is None:
+                continue
+            # compression.encode_model_payload IS the registry path.
+            if "compression.encode_model_payload" in stripped:
+                continue
+            out.append(f"{r}:{lineno}: {line.strip()}")
+    return out
+
+
+# The zero-copy model plane routes every leaf-byte extraction through
+# serialization.leaf_bytes (borrowed memoryview, no copy) and every
+# decode through zero-copy frombuffer views.
+COPIES_ALLOWED = {
+    "tpfl/learning/serialization.py",
+    "tpfl/learning/compression.py",
+}
+
+COPY_PATTERN = re.compile(
+    r"\.tobytes\s*\(" r"|frombuffer\s*\([^)]*\)\s*\.copy\s*\("
+)
+
+
+def check_copies(repo: "pathlib.Path | None" = None) -> list[str]:
+    """Return 'path:line: offending text' for array-byte copies outside
+    the serialization layer (route through serialization.leaf_bytes /
+    the versioned decode views)."""
+    root = repo_root(repo)
+    out: list[str] = []
+    for path in py_files(root):
+        r = rel(root, path)
+        if r in COPIES_ALLOWED:
+            continue
+        for lineno, line in enumerate(
+            path.read_text(encoding="utf-8").splitlines(), 1
+        ):
+            stripped = line.split("#", 1)[0]
+            if COPY_PATTERN.search(stripped):
+                out.append(f"{r}:{lineno}: {line.strip()}")
+    return out
+
+
+# The only module allowed to touch gRPC stubs/channels.
+RPC_ALLOWED = {
+    "tpfl/communication/grpc_transport.py",
+}
+
+# The only modules allowed to call the raw transport hook: base.py owns
+# the retrying dispatch (and the disconnect farewell, deliberately
+# fire-once); the transports implement the hook.
+SEND_ALLOWED = {
+    "tpfl/communication/base.py",
+    "tpfl/communication/grpc_transport.py",
+    "tpfl/communication/memory.py",
+}
+
+# Raw RPC entry points: stub tables, channel construction, stub calls.
+RPC_PATTERN = re.compile(
+    r"""\[['"]stubs['"]\]"""
+    r"|\.unary_unary\s*\("
+    r"|\.unary_stream\s*\("
+    r"|\.stream_unary\s*\("
+    r"|grpc\.(?:insecure|secure)_channel\s*\("
+)
+
+# Direct transport-hook calls (not the `def` lines that implement it).
+SEND_PATTERN = re.compile(r"\._transport_send(?:_corrupted)?\s*\(")
+
+
+def check_rpc(repo: "pathlib.Path | None" = None) -> list[str]:
+    """Return 'path:line: offending text' for outbound RPC call sites
+    that bypass the retrying send path."""
+    root = repo_root(repo)
+    out: list[str] = []
+    for path in py_files(root):
+        r = rel(root, path)
+        for lineno, line in enumerate(
+            path.read_text(encoding="utf-8").splitlines(), 1
+        ):
+            stripped = line.split("#", 1)[0]
+            if r not in RPC_ALLOWED and RPC_PATTERN.search(stripped):
+                out.append(f"{r}:{lineno}: {line.strip()}")
+            elif r not in SEND_ALLOWED and SEND_PATTERN.search(stripped):
+                out.append(f"{r}:{lineno}: {line.strip()}")
+    return out
+
+
+def violations(repo: "pathlib.Path | None" = None) -> list[Violation]:
+    """All three wire checks as tpflcheck Violations."""
+    out: list[Violation] = []
+    for name, fn, hint in (
+        ("wire", check, "serialize through the codec registry"),
+        ("wire-copies", check_copies, "route through serialization.leaf_bytes"),
+        ("wire-rpc", check_rpc, "route through ThreadedCommunicationProtocol.send"),
+    ):
+        for entry in fn(repo):
+            loc, _, text = entry.partition(": ")
+            file, _, line = loc.rpartition(":")
+            out.append(
+                Violation(
+                    check=name,
+                    file=file,
+                    line=int(line or 0),
+                    message=f"{text} ({hint})",
+                    key=f"{name}:{loc}",
+                )
+            )
+    return out

@@ -1,219 +1,70 @@
 #!/usr/bin/env python
-"""Wire-path lint: model payloads must go through the codec registry,
-outbound RPCs must go through the retrying send path, and array bytes
-must not be copied outside the serialization layer.
+"""Thin shim — the wire lints moved into the tpflcheck suite.
 
-Fails (exit 1) when any file under ``tpfl/`` serializes model payloads
-with raw ``serialization.encode_pytree`` / ``encode_model_payload`` /
-``msgpack.packb`` outside the allowlisted modules. A new code path that
-builds weight bytes by hand bypasses the versioned codec envelope
-(``tpfl/learning/compression.py``): its payloads would never quantize,
-never delta-encode, and — worse — old/new peers could stop agreeing on
-the wire format without any test noticing.
+``tools/wirecheck.py`` grew two siblings (copy-discipline, RPC-path)
+and then a whole framework: guarded-by race lint, lock-order deadlock
+detection, layer/knob/thread lints — ``tools/tpflcheck/``. The three
+original checks live in :mod:`tools.tpflcheck.wire` unchanged; this
+file keeps the historical entry point (``python tools/wirecheck.py``)
+and the ``import wirecheck`` surface the test suite uses.
 
-Second check (:func:`check_rpc`): no code outside the transport layer
-may invoke a gRPC stub/channel or call ``_transport_send`` directly.
-Every outbound message must flow through
-``ThreadedCommunicationProtocol.send`` — that is where retry/backoff,
-the circuit breaker, the fault injector, and the send-health counters
-live (``communication/base.py``); a raw ``conn["stubs"]["Send"](...)``
-call site would silently skip all four.
-
-Allowlist (each with a reason):
-
-- ``learning/serialization.py``   the v1 envelope implementation
-- ``learning/compression.py``     the v2 codec implementation
-- ``learning/model.py``           ``encode_parameters`` — the registry
-                                  dispatch itself (dense-vs-codec)
-- ``communication/message.py``    transport framing (control fields +
-                                  already-encoded payload bytes)
-- ``communication/grpc_transport.py``  RPC control frames and chunk
-                                  frames around already-encoded bytes
-- ``management/checkpoint.py``    on-DISK format, deliberately exact
-                                  (never rides the wire)
-
-Run: ``python tools/wirecheck.py`` (repo root inferred). Used by the
-test suite (tests/test_compression.py) so a violation fails CI.
+Prefer ``python -m tools.tpflcheck`` — it runs these three checks AND
+the rest of the suite.
 """
 
 from __future__ import annotations
 
 import pathlib
-import re
 import sys
 
-ALLOWED = {
-    "tpfl/learning/serialization.py",
-    "tpfl/learning/compression.py",
-    "tpfl/learning/model.py",
-    "tpfl/communication/message.py",
-    "tpfl/communication/grpc_transport.py",
-    "tpfl/management/checkpoint.py",
-}
+_ROOT = pathlib.Path(__file__).resolve().parent.parent
+if str(_ROOT) not in sys.path:
+    sys.path.insert(0, str(_ROOT))
 
-# Raw serialization entry points a wire path must not touch directly.
-PATTERN = re.compile(
-    r"(?<![\w.])(?:serialization\.)?(?:encode_pytree|encode_model_payload)\s*\("
-    r"|msgpack\.packb\s*\("
+from tools.tpflcheck.wire import (  # noqa: E402  (path bootstrap above)
+    check,
+    check_copies,
+    check_rpc,
 )
 
-
-def check(repo_root: "pathlib.Path | None" = None) -> list[str]:
-    """Return a list of 'path:line: offending text' violations."""
-    root = repo_root or pathlib.Path(__file__).resolve().parent.parent
-    violations: list[str] = []
-    for path in sorted((root / "tpfl").rglob("*.py")):
-        rel = path.relative_to(root).as_posix()
-        if rel in ALLOWED:
-            continue
-        for lineno, line in enumerate(
-            path.read_text(encoding="utf-8").splitlines(), 1
-        ):
-            stripped = line.split("#", 1)[0]
-            m = PATTERN.search(stripped)
-            if m is None:
-                continue
-            # compression.encode_model_payload IS the registry path.
-            if "compression.encode_model_payload" in stripped:
-                continue
-            violations.append(f"{rel}:{lineno}: {line.strip()}")
-    return violations
-
-
-# --- copy-discipline lint ------------------------------------------------
-
-# The zero-copy model plane routes every leaf-byte extraction through
-# serialization.leaf_bytes (borrowed memoryview, no copy) and every
-# decode through zero-copy frombuffer views. A stray `.tobytes()` or a
-# `frombuffer(...).copy()` outside the two serialization modules
-# reintroduces exactly the per-leaf memcpy the v3 layout removed — and
-# does it silently, since the payload still round-trips.
-COPIES_ALLOWED = {
-    # The serialization layer itself: leaf_bytes' last-resort fallback
-    # and the envelope implementations.
-    "tpfl/learning/serialization.py",
-    "tpfl/learning/compression.py",
-}
-
-COPY_PATTERN = re.compile(
-    r"\.tobytes\s*\(" r"|frombuffer\s*\([^)]*\)\s*\.copy\s*\("
-)
-
-
-def check_copies(repo_root: "pathlib.Path | None" = None) -> list[str]:
-    """Return 'path:line: offending text' for array-byte copies outside
-    the serialization layer (route through serialization.leaf_bytes /
-    the versioned decode views)."""
-    root = repo_root or pathlib.Path(__file__).resolve().parent.parent
-    violations: list[str] = []
-    for path in sorted((root / "tpfl").rglob("*.py")):
-        rel = path.relative_to(root).as_posix()
-        if rel in COPIES_ALLOWED:
-            continue
-        for lineno, line in enumerate(
-            path.read_text(encoding="utf-8").splitlines(), 1
-        ):
-            stripped = line.split("#", 1)[0]
-            if COPY_PATTERN.search(stripped):
-                violations.append(f"{rel}:{lineno}: {line.strip()}")
-    return violations
-
-
-# --- RPC-path lint -------------------------------------------------------
-
-# The only module allowed to touch gRPC stubs/channels.
-RPC_ALLOWED = {
-    "tpfl/communication/grpc_transport.py",
-}
-
-# The only modules allowed to call the raw transport hook: base.py owns
-# the retrying dispatch (and the disconnect farewell, deliberately
-# fire-once); the transports implement the hook.
-SEND_ALLOWED = {
-    "tpfl/communication/base.py",
-    "tpfl/communication/grpc_transport.py",
-    "tpfl/communication/memory.py",
-}
-
-# Raw RPC entry points: stub tables, channel construction, stub calls.
-RPC_PATTERN = re.compile(
-    r"""\[['"]stubs['"]\]"""
-    r"|\.unary_unary\s*\("
-    r"|\.unary_stream\s*\("
-    r"|\.stream_unary\s*\("
-    r"|grpc\.(?:insecure|secure)_channel\s*\("
-)
-
-# Direct transport-hook calls (not the `def` lines that implement it).
-SEND_PATTERN = re.compile(r"\._transport_send(?:_corrupted)?\s*\(")
-
-
-def check_rpc(repo_root: "pathlib.Path | None" = None) -> list[str]:
-    """Return 'path:line: offending text' for outbound RPC call sites
-    that bypass the retrying send path."""
-    root = repo_root or pathlib.Path(__file__).resolve().parent.parent
-    violations: list[str] = []
-    for path in sorted((root / "tpfl").rglob("*.py")):
-        rel = path.relative_to(root).as_posix()
-        for lineno, line in enumerate(
-            path.read_text(encoding="utf-8").splitlines(), 1
-        ):
-            stripped = line.split("#", 1)[0]
-            if rel not in RPC_ALLOWED and RPC_PATTERN.search(stripped):
-                violations.append(f"{rel}:{lineno}: {line.strip()}")
-            elif rel not in SEND_ALLOWED and SEND_PATTERN.search(stripped):
-                violations.append(f"{rel}:{lineno}: {line.strip()}")
-    return violations
+__all__ = ["check", "check_copies", "check_rpc", "main"]
 
 
 def main() -> int:
     rc = 0
-    violations = check()
-    if violations:
-        print(
-            "wirecheck FAILED — model payloads serialized outside the "
-            "codec registry (route through TpflModel.encode_parameters "
-            "or tpfl.learning.compression):",
-            file=sys.stderr,
-        )
-        for v in violations:
-            print(f"  {v}", file=sys.stderr)
-        rc = 1
-    else:
-        print(
-            "wirecheck OK — all model payload paths go through the codec registry"
-        )
-    copy_violations = check_copies()
-    if copy_violations:
-        print(
-            "wirecheck FAILED — array bytes copied outside the "
-            "serialization layer (route through serialization.leaf_bytes "
-            "or the zero-copy decode views):",
-            file=sys.stderr,
-        )
-        for v in copy_violations:
-            print(f"  {v}", file=sys.stderr)
-        rc = 1
-    else:
-        print(
-            "wirecheck OK — no array-byte copies outside the serialization layer"
-        )
-    rpc_violations = check_rpc()
-    if rpc_violations:
-        print(
-            "wirecheck FAILED — raw RPC/transport call sites bypass the "
-            "retrying send path (route through "
-            "ThreadedCommunicationProtocol.send):",
-            file=sys.stderr,
-        )
-        for v in rpc_violations:
-            print(f"  {v}", file=sys.stderr)
-        rc = 1
-    else:
-        print(
-            "wirecheck OK — all outbound RPC call sites go through the "
-            "retrying send path"
-        )
+    for label, fn, ok_msg, fail_msg in (
+        (
+            "wire",
+            check,
+            "all model payload paths go through the codec registry",
+            "model payloads serialized outside the codec registry "
+            "(route through TpflModel.encode_parameters or "
+            "tpfl.learning.compression)",
+        ),
+        (
+            "copies",
+            check_copies,
+            "no array-byte copies outside the serialization layer",
+            "array bytes copied outside the serialization layer "
+            "(route through serialization.leaf_bytes or the zero-copy "
+            "decode views)",
+        ),
+        (
+            "rpc",
+            check_rpc,
+            "all outbound RPC call sites go through the retrying send path",
+            "raw RPC/transport call sites bypass the retrying send path "
+            "(route through ThreadedCommunicationProtocol.send)",
+        ),
+    ):
+        violations = fn()
+        if violations:
+            print(f"wirecheck FAILED — {fail_msg}:", file=sys.stderr)
+            for v in violations:
+                print(f"  {v}", file=sys.stderr)
+            rc = 1
+        else:
+            print(f"wirecheck OK — {ok_msg}")
     return rc
 
 

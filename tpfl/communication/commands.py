@@ -116,7 +116,7 @@ class ModelInitializedCommand(NodeCommand):
     name = "model_initialized"
 
     def execute(self, source: str, round: int, **kwargs: Any) -> None:
-        self.state.nei_status[source] = -1
+        self.state.set_nei_status(source, -1)
 
 
 class InitModelRequestCommand(NodeCommand):
@@ -251,7 +251,7 @@ class ModelsReadyCommand(NodeCommand):
                 f"ModelsReady from {source} round {round} dropped (at {st.round})",
             )
             return
-        st.nei_status[source] = round
+        st.set_nei_status(source, round)
 
 
 class MetricsCommand(NodeCommand):
@@ -496,12 +496,13 @@ class FullModelCommand(NodeCommand):
 
             def _relay() -> None:
                 try:
+                    status = st.get_nei_status()
                     lagging = [
                         n
                         for n in node.communication.get_neighbors(
                             only_direct=True
                         )
-                        if n != source and st.nei_status.get(n, -1) < round
+                        if n != source and status.get(n, -1) < round
                     ]
                     if not lagging:
                         return

@@ -24,6 +24,7 @@ from collections import deque
 from typing import Any, Callable, Optional
 
 from tpfl.communication.message import Message
+from tpfl.concurrency import make_lock
 from tpfl.management.logger import logger
 from tpfl.settings import Settings
 
@@ -47,16 +48,20 @@ class Gossiper(threading.Thread):
         # neighbor otherwise costs a (possibly retried) failed send for
         # EVERY forwarded message until eviction.
         self._link_ok = link_ok_fn or (lambda nei: True)
+        # guarded-by: _pending_lock
         self._pending: deque[Message] = deque()
+        # guarded-by: _pending_lock
         self._priority: deque[Message] = deque()
-        self._pending_lock = threading.Lock()
+        self._pending_lock = make_lock("Gossiper._pending_lock")
         # FIFO eviction ring + set: membership must be O(1) — a plain
         # deque scan is O(AMOUNT_LAST_MESSAGES_SAVED) per message and
         # melts the relay hub of a star topology at scale (every vote /
         # status broadcast crosses it twice).
+        # guarded-by: _processed_lock
         self._processed_ring: deque[str] = deque()
+        # guarded-by: _processed_lock
         self._processed_set: set[str] = set()
-        self._processed_lock = threading.Lock()
+        self._processed_lock = make_lock("Gossiper._processed_lock")
         self._stop_event = threading.Event()
         self._wake = threading.Event()
         seed = (Settings.SEED or 0) + zlib.crc32(self_addr.encode())

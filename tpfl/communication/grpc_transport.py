@@ -191,7 +191,11 @@ class GrpcCommunicationProtocol(ThreadedCommunicationProtocol):
         }
         self._server = grpc.server(
             futures.ThreadPoolExecutor(
-                max_workers=Settings.GRPC_SERVER_WORKERS
+                max_workers=Settings.GRPC_SERVER_WORKERS,
+                # Real names in deadlock/lock-trace reports: a handler
+                # thread showing up as "grpc-<addr>_3" beats "Thread-7"
+                # (thread-lifecycle lint, tools/tpflcheck/threads.py).
+                thread_name_prefix=f"grpc-{self._addr}",
             ),
             options=self._channel_options(),
         )

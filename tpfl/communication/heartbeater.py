@@ -73,9 +73,12 @@ class Heartbeater(threading.Thread):
     def _digest(self) -> list[str]:
         now = time.time()
         args = [str(now)]
-        for addr, nei in self._neighbors.get_all().items():
+        # One locked snapshot (digest_entries), not a live-entry walk:
+        # last_beat is table-lock-guarded state and writers refresh it
+        # concurrently with every incoming beat.
+        for addr, last_beat in self._neighbors.digest_entries():
             args.append(addr)
-            args.append(f"{max(0.0, now - nei.last_beat):.3f}")
+            args.append(f"{max(0.0, now - last_beat):.3f}")
         return args
 
     def run(self) -> None:

@@ -13,15 +13,21 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
+from tpfl.concurrency import make_lock
+
+
+def _make_dial_lock() -> "threading.Lock":
+    return make_lock("Neighbor.dial_lock")  # type: ignore[return-value]
+
 
 @dataclass
 class Neighbor:
     conn: Any  # transport-specific handle (None for non-direct peers)
     direct: bool
-    last_beat: float
+    last_beat: float  # guarded-by Neighbors._lock (the owning table's)
     # Serializes lazy back-channel dials (base.py send path) so
     # concurrent senders don't each open-and-leak a connection.
-    dial_lock: threading.Lock = field(default_factory=threading.Lock)
+    dial_lock: threading.Lock = field(default_factory=_make_dial_lock)
 
 
 class Neighbors:
@@ -38,8 +44,9 @@ class Neighbors:
         self._connect_fn = connect_fn
         self._disconnect_fn = disconnect_fn
         self._close_fn = close_fn
-        self._neighbors: dict[str, Neighbor] = {}
-        self._lock = threading.Lock()
+        # guarded-by: _lock
+        self._table: dict[str, Neighbor] = {}
+        self._lock = make_lock("Neighbors._lock")
 
     def add(
         self,
@@ -67,7 +74,7 @@ class Neighbors:
             return False
         stamp = beat_time if beat_time is not None else time.time()
         with self._lock:
-            existing = self._neighbors.get(addr)
+            existing = self._table.get(addr)
             if existing is not None:
                 # Upgrade non-direct -> direct if needed.
                 if existing.direct or non_direct:
@@ -84,7 +91,7 @@ class Neighbors:
         with self._lock:
             # Re-check: a concurrent add (e.g. the peer's handshake RPC
             # racing our connect) may have inserted while we dialed.
-            existing = self._neighbors.get(addr)
+            existing = self._table.get(addr)
             if existing is not None and (existing.direct or non_direct):
                 existing.last_beat = max(existing.last_beat, stamp)
                 if not non_direct and existing.conn is None and conn is not None:
@@ -92,7 +99,7 @@ class Neighbors:
                 else:
                     leaked = conn  # theirs wins; release ours below
             else:
-                self._neighbors[addr] = Neighbor(
+                self._table[addr] = Neighbor(
                     conn=conn, direct=not non_direct, last_beat=stamp
                 )
         if leaked is not None and self._close_fn is not None:
@@ -104,7 +111,7 @@ class Neighbors:
 
     def remove(self, addr: str, disconnect_msg: bool = False) -> None:
         with self._lock:
-            nei = self._neighbors.pop(addr, None)
+            nei = self._table.pop(addr, None)
         if nei is None:
             return
         if disconnect_msg and nei.direct and self._disconnect_fn is not None:
@@ -130,7 +137,7 @@ class Neighbors:
             return
         t = beat_time if beat_time is not None else time.time()
         with self._lock:
-            nei = self._neighbors.get(addr)
+            nei = self._table.get(addr)
             if nei is not None:
                 nei.last_beat = max(nei.last_beat, t)
                 return
@@ -153,7 +160,7 @@ class Neighbors:
             for addr, beat_time in entries:
                 if addr == self.self_addr:
                     continue
-                nei = self._neighbors.get(addr)
+                nei = self._table.get(addr)
                 if nei is not None:
                     nei.last_beat = max(nei.last_beat, beat_time)
                 elif max_age is None or now - beat_time < max_age:
@@ -170,7 +177,7 @@ class Neighbors:
         so callers cannot leak what they dialed."""
         close = None
         with self._lock:
-            nei = self._neighbors.get(addr)
+            nei = self._table.get(addr)
             if nei is None or not nei.direct:
                 close, result = conn, None
             elif nei.conn is None:
@@ -187,24 +194,34 @@ class Neighbors:
 
     def get_conn(self, addr: str) -> Any:
         with self._lock:
-            nei = self._neighbors.get(addr)
+            nei = self._table.get(addr)
             return nei.conn if nei is not None else None
 
     def get(self, addr: str) -> Optional[Neighbor]:
         with self._lock:
-            return self._neighbors.get(addr)
+            return self._table.get(addr)
 
     def exists(self, addr: str) -> bool:
         with self._lock:
-            return addr in self._neighbors
+            return addr in self._table
 
     def get_all(self, only_direct: bool = False) -> dict[str, Neighbor]:
         with self._lock:
             return {
                 a: n
-                for a, n in self._neighbors.items()
+                for a, n in self._table.items()
                 if n.direct or not only_direct
             }
+
+    def digest_entries(self) -> list[tuple[str, float]]:
+        """``(addr, last_beat)`` snapshot for the heartbeat digest,
+        taken under ONE lock acquisition. The heartbeater previously
+        read ``nei.last_beat`` off live entries returned by
+        :meth:`get_all` — outside the table lock, racing the writers
+        that refresh freshness (the guarded-by lint's canonical bare-
+        iteration finding)."""
+        with self._lock:
+            return [(a, n.last_beat) for a, n in self._table.items()]
 
     def evict_stale(self, timeout: float) -> list[str]:
         """Drop peers not heard from within ``timeout`` (reference
@@ -221,12 +238,12 @@ class Neighbors:
         with self._lock:
             stale_direct = [
                 a
-                for a, n in self._neighbors.items()
+                for a, n in self._table.items()
                 if n.direct and now - n.last_beat > timeout
             ]
-            self._neighbors = {
+            self._table = {
                 a: n
-                for a, n in self._neighbors.items()
+                for a, n in self._table.items()
                 if n.direct or now - n.last_beat <= timeout
             }
         for a in stale_direct:
@@ -235,6 +252,6 @@ class Neighbors:
 
     def clear(self) -> None:
         with self._lock:
-            addrs = list(self._neighbors)
+            addrs = list(self._table)
         for a in addrs:
             self.remove(a, disconnect_msg=True)

@@ -22,11 +22,11 @@ vanishing at debug level.
 from __future__ import annotations
 
 import random
-import threading
 import time
 from dataclasses import dataclass, field
 from typing import Optional
 
+from tpfl.concurrency import make_lock
 from tpfl.management.logger import logger
 from tpfl.settings import Settings
 
@@ -69,8 +69,9 @@ class CircuitBreaker:
 
     def __init__(self, self_addr: str) -> None:
         self._addr = self_addr
+        # guarded-by: _lock
         self._peers: dict[str, _PeerHealth] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("CircuitBreaker._lock")
 
     def _peer(self, addr: str) -> _PeerHealth:
         h = self._peers.get(addr)

@@ -25,6 +25,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
+from tpfl.concurrency import make_lock
 from tpfl.learning.model import TpflModel
 from tpfl.management.logger import logger
 from tpfl.settings import Settings
@@ -71,10 +72,14 @@ class AggStream:
     def __init__(self, template: TpflModel) -> None:
         self.acc: Any = None
         self.template = template
+        # unguarded: AggStream is owned state of one Aggregator — every
+        # accumulate/finalize touching it runs under Aggregator._lock
+        # or on the single thread that took the stream out of it.
         self.contributors: set[str] = set()
         self.num_samples = 0
         self.count = 0
         self.offered = 0
+        # unguarded: same ownership as contributors above.
         self.extra: dict[str, Any] = {}
 
 
@@ -87,24 +92,30 @@ class Aggregator(ABC):
 
     def __init__(self, node_name: str = "unknown") -> None:
         self.node_name = node_name
+        # guarded-by: _lock
         self._train_set: list[str] = []
+        # guarded-by: _lock
         self._models: list[TpflModel] = []
         # Eager streaming accumulator (Settings.AGG_STREAM_EAGER):
         # contributions fold on-device as add_model accepts them, so
         # the round-close aggregation is one finalize. None until the
         # first accepted model; dropped on any fold error (the close
         # falls back to the sorted batch fold).
+        # guarded-by: _lock
         self._stream: "AggStream | None" = None
+        # guarded-by: _lock
         self._stream_dead = False
         # Members dropped by remove_dead_nodes this round — a partial
         # bundling one of them re-admits it (see add_model).
+        # guarded-by: _lock
         self._removed_dead: set[str] = set()
-        self._lock = threading.Lock()
+        self._lock = make_lock("Aggregator._lock")
         self._finish_aggregation_event = threading.Event()
         self._finish_aggregation_event.set()
         # Monotonic, not wall clock: stalled() measures an interval, and
         # an NTP step during a round would otherwise suppress the stall
         # exit (clock jumps back) or fire it prematurely (jumps forward).
+        # guarded-by: _lock
         self._last_intake = time.monotonic()
         # Bumped on every state change (round start/end, model added).
         # Gossip loops key their encoded-payload caches on it: between
@@ -112,6 +123,9 @@ class Aggregator(ABC):
         # byte-identical, and re-running the jitted aggregation + the
         # device->host transfer + msgpack encode per push tick was the
         # measured formation bottleneck at 1000 single-core nodes.
+        # Writes serialize under _lock; stages read it lock-free as a
+        # cache key (a stale int read costs one redundant encode).
+        # guarded-by: _lock writes
         self.version = 0
 
     # --- math (subclasses) ---
@@ -403,12 +417,16 @@ class Aggregator(ABC):
                 self._models, key=lambda m: tuple(sorted(m.get_contributors()))
             )
             stream, self._stream = self._stream, None
+            # Snapshot for the timeout log below: _train_set is
+            # _lock-guarded state and remove_dead_nodes/add_model keep
+            # mutating it after this block releases the lock.
+            train_set = list(self._train_set)
         if not finished:
             missing = self.get_missing_models()
             logger.warning(
                 self.node_name,
                 f"Aggregation timed out; proceeding without {missing} "
-                f"(train_set={self._train_set}, held="
+                f"(train_set={train_set}, held="
                 f"{[m.get_contributors() for m in models]})",
             )
         if not models:

@@ -34,6 +34,8 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
+from tpfl.concurrency import make_lock
+
 
 def _bucket(size: int) -> int:
     """Power-of-two capacity bucket (min 4 KiB) for ``size`` bytes."""
@@ -102,10 +104,14 @@ class BufferPool:
     ) -> None:
         self.max_buffers = int(max_buffers)
         self.max_bytes = int(max_bytes)
-        self._lock = threading.Lock()
+        self._lock = make_lock("BufferPool._lock")
+        # guarded-by: _lock
         self._free: list[bytearray] = []
+        # guarded-by: _lock
         self._outstanding = 0
+        # guarded-by: _lock writes
         self.hits = 0
+        # guarded-by: _lock writes
         self.misses = 0
 
     # --- lease / return ---

@@ -19,10 +19,16 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Any, Optional
+from typing import TYPE_CHECKING, Any, Optional
 
-from tpfl.learning import serialization
-from tpfl.learning.model import TpflModel
+if TYPE_CHECKING:
+    from tpfl.learning.model import TpflModel
+
+# tpfl.learning.serialization is imported INSIDE the save/load
+# functions: management sits below learning in the layer map
+# (tools/tpflcheck/layers.py), and checkpointing is the one management
+# feature that needs the learning layer's encoder — a lazy seam keeps
+# the module-level import graph acyclic and layer-clean.
 
 _MODEL_FILE = "model.tpfl"
 _AUX_FILE = "aux.tpfl"
@@ -44,6 +50,8 @@ def save_node_checkpoint(
     publish it — a crash at any point leaves the previous complete
     checkpoint intact (no torn model/aux/meta mix), and stale aux from
     an earlier save can never attach to a model without one."""
+    from tpfl.learning import serialization
+
     os.makedirs(directory, exist_ok=True)
     import uuid
 
@@ -128,6 +136,8 @@ def load_node_checkpoint(
     ``template`` supplies the architecture (flax module + param
     structure); the checkpointed params/info are loaded into a copy.
     """
+    from tpfl.learning import serialization
+
     sub = _read_latest(directory)
     if sub is None:
         raise FileNotFoundError(f"No checkpoint published in {directory}")

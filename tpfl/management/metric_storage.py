@@ -21,7 +21,8 @@ thread all log concurrently.
 from __future__ import annotations
 
 import copy
-import threading
+
+from tpfl.concurrency import make_lock
 
 LocalMetrics = dict[str, dict[int, dict[str, dict[str, list[tuple[int, float]]]]]]
 GlobalMetrics = dict[str, dict[str, dict[str, list[tuple[int, float]]]]]
@@ -31,8 +32,9 @@ class LocalMetricStorage:
     """exp -> round -> node -> metric -> [(step, value)]"""
 
     def __init__(self) -> None:
+        # guarded-by: _lock
         self._store: LocalMetrics = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("LocalMetricStorage._lock")
 
     def add_log(
         self,
@@ -70,8 +72,9 @@ class GlobalMetricStorage:
     """exp -> node -> metric -> [(round, value)] (deduped per round)"""
 
     def __init__(self) -> None:
+        # guarded-by: _lock
         self._store: GlobalMetrics = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("GlobalMetricStorage._lock")
 
     def add_log(
         self, exp_name: str, round: int, metric: str, node: str, val: float
@@ -110,8 +113,9 @@ class TransportMetricStorage:
     per-round store cannot."""
 
     def __init__(self) -> None:
+        # guarded-by: _lock
         self._store: TransportMetrics = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("TransportMetricStorage._lock")
 
     def _entry(self, node: str, neighbor: str) -> dict[str, object]:
         nd = self._store.setdefault(node, {})

@@ -153,6 +153,15 @@ class Node:
         logger.unregister_node(self.addr)
         self._running = False
         logger.info(self.addr, "Node stopped")
+        if Settings.LOCK_TRACING:
+            # Traced runs (chaos/e2e) check the RUNTIME lock-acquisition
+            # graph on the way out: a cycle is a latent deadlock, and
+            # the LockOrderError carries the witness chain with real
+            # thread names. The static half runs in CI
+            # (python -m tools.tpflcheck).
+            from tpfl.concurrency import lock_graph
+
+            lock_graph.assert_acyclic()
 
     # --- topology (reference node.py:140-184) ---
 

@@ -6,6 +6,12 @@ here are the synchronization points between protocol handler threads
 (stages blocking on events). The reference uses raw ``threading.Lock``
 acquire/release pairs as signals; here they are ``threading.Event``s,
 which express the same handoffs without the acquire-twice idiom.
+
+Concurrency contract: every mutable field carries a ``# guarded-by:``
+or ``# unguarded:`` annotation, enforced by the static race lint
+(``tools/tpflcheck/guards.py``) — a read/write of a guarded field
+outside a ``with <lock>:`` block fails CI. The thread map (who touches
+what from where) is in docs/concurrency.md.
 """
 
 from __future__ import annotations
@@ -13,6 +19,7 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
+from tpfl.concurrency import make_lock
 from tpfl.experiment import Experiment
 
 
@@ -27,45 +34,74 @@ class NodeState:
         # Votes are tagged with the voter's round: a fast peer's round-r+1
         # vote arriving while we are still in round r must survive our
         # round-r tally and cleanup (the tally filters by round).
+        # unguarded: replaced wholesale by the learning thread between
+        # rounds; command/stage readers iterate whichever snapshot
+        # reference they loaded (atomic under the GIL), never a
+        # half-built list.
         self.train_set: list[str] = []
+        # guarded-by: train_set_votes_lock
         self.train_set_votes: dict[str, tuple[int, dict[str, int]]] = {}
-        self.train_set_votes_lock = threading.Lock()
+        self.train_set_votes_lock = make_lock("NodeState.train_set_votes_lock")
         self.votes_ready_event = threading.Event()
 
         # Model lifecycle events
         self.model_initialized_event = threading.Event()
         self.aggregated_model_event = threading.Event()
+        # guarded-by: relay_lock writes
         self.last_full_model_round: int = -1
         """Highest round for which a FullModel was received/produced —
         compared against the current round by WaitAggregatedModelsStage
-        (event-only signalling can lose an early-arriving FullModel)."""
-        self.relay_lock = threading.Lock()
+        (event-only signalling can lose an early-arriving FullModel).
+        Writes are read-modify-write (``max``) racing between the
+        learning thread (TrainStage adoption) and gRPC handlers
+        (FullModelCommand), so they serialize under ``relay_lock``;
+        lock-free reads are safe — a monotonic int watermark read is
+        atomic under the GIL and a stale read only delays adoption by
+        one poll tick."""
+        self.relay_lock = make_lock("NodeState.relay_lock")
+        # guarded-by: relay_lock
         self.last_relayed_round: int = -1
         """Epidemic-relay bookkeeping (FullModelCommand): highest round
         whose aggregate this node has re-sent to lagging neighbors.
         Check-and-mark happens under ``relay_lock`` — concurrent
         deliveries of the same round from two peers (gRPC handler pool)
         must not both fan the payload out."""
+        # guarded-by: relay_lock writes
         self.model_version: int = 0
         """Bumped whenever an incoming FullModelCommand replaces the
         learner's model. GossipModelStage keys its encoded-payload
         cache on it: a round's AUTHORITATIVE aggregate can land while
         the stage is mid-push (the node entered holding a timed-out
         partial aggregate), and the cached stale bytes must not keep
-        flowing."""
+        flowing. ``+=`` from concurrent handlers loses bumps, hence
+        writes under ``relay_lock``; cache-key reads are lock-free."""
 
         # Gossip bookkeeping
+        # guarded-by: models_aggregated_lock
         self.models_aggregated: dict[str, list[str]] = {}
-        self.models_aggregated_lock = threading.Lock()
-        self.nei_status: dict[str, int] = {}  # addr -> last finished round (-1 = model initialized)
+        self.models_aggregated_lock = make_lock(
+            "NodeState.models_aggregated_lock"
+        )
+        # guarded-by: nei_status_lock
+        self.nei_status: dict[str, int] = {}
+        """addr -> last finished round (-1 = model initialized).
+        Written by command handlers (gRPC pool / relay threads), read —
+        and previously ITERATED bare — by the learning thread's gossip
+        closures; a handler insert during ``sorted(nei_status)`` raises
+        ``RuntimeError: dictionary changed size during iteration``.
+        All access goes through the accessors below."""
+        self.nei_status_lock = make_lock("NodeState.nei_status_lock")
 
         # Next-round partial models. At scale, a fast peer's round-r+1
         # PartialModel can arrive while this node is still closing round
         # r; dropping it (reference partial_model_command.py:72-82) makes
         # the late trainer block the whole AGGREGATION_TIMEOUT. Stash and
         # replay when the round's TrainStage opens.
+        # guarded-by: pending_partials_lock
         self.pending_partials: list[tuple] = []
-        self.pending_partials_lock = threading.Lock()
+        self.pending_partials_lock = make_lock(
+            "NodeState.pending_partials_lock"
+        )
 
         # Delta-gossip wire state (tpfl.learning.compression): the
         # round -> full-model bases this node has adopted (what residual
@@ -74,7 +110,12 @@ class NodeState:
         # until the next experiment.
         from tpfl.learning.compression import BaseCache
 
+        # unguarded: BaseCache is internally synchronized (own _lock).
         self.wire_bases = BaseCache()
+        # unguarded: handler threads add(), the learning thread tests
+        # membership and replaces the set wholesale at round
+        # boundaries — all GIL-atomic set ops on a best-effort hint
+        # (a missed nack costs one redundant delta push, re-nacked).
         self.delta_nack_peers: set[str] = set()
 
     # --- experiment delegation (reference node_state.py:84-97) ---
@@ -135,6 +176,21 @@ class NodeState:
         with self.models_aggregated_lock:
             return dict(self.models_aggregated)
 
+    # --- nei_status accessors (the only sanctioned access paths) ---
+
+    def set_nei_status(self, addr: str, round: int) -> None:
+        with self.nei_status_lock:
+            self.nei_status[addr] = round
+
+    def get_nei_status(self) -> dict[str, int]:
+        """Snapshot copy — safe to iterate/sort outside the lock."""
+        with self.nei_status_lock:
+            return dict(self.nei_status)
+
+    def nei_status_of(self, addr: str, default: int = -1) -> int:
+        with self.nei_status_lock:
+            return self.nei_status.get(addr, default)
+
     def prepare_experiment(self) -> None:
         """Reset per-experiment bookkeeping before the learning thread
         spawns. Preserves ``model_initialized_event`` and ``nei_status``
@@ -145,8 +201,8 @@ class NodeState:
         with self.models_aggregated_lock:
             self.models_aggregated = {}
         self.train_set = []
-        self.last_full_model_round = -1
         with self.relay_lock:
+            self.last_full_model_round = -1
             self.last_relayed_round = -1
         self.votes_ready_event.clear()
         self.aggregated_model_event.clear()
@@ -161,7 +217,8 @@ class NodeState:
         self.status = "Idle"
         self.experiment = None
         self.prepare_experiment()
-        self.nei_status = {}
+        with self.nei_status_lock:
+            self.nei_status = {}
         self.model_initialized_event.clear()
 
     def __repr__(self) -> str:

@@ -314,6 +314,20 @@ class Settings:
     to 2.0 — hundreds of waiters waking 2x/s are a measurable GIL tax
     at 1000 in-process nodes."""
 
+    # --- concurrency diagnostics ---
+    LOCK_TRACING: bool = False
+    """Opt-in runtime lock-order tracing (tpfl.concurrency): every lock
+    built through ``make_lock`` becomes a ``TracedLock`` that records
+    the acquisition graph (lock A held while acquiring lock B ⇒ edge
+    A→B, witnessed by the acquiring thread's name), and ``Node.stop``
+    asserts the graph is acyclic — a cycle is a latent deadlock, and
+    the error carries the witness chain. Read at lock CREATION time, so
+    it must be set before nodes are built. Off by default (one
+    thread-local append per acquire, measured <10% round-throughput
+    overhead in bench.py's analysis tier — fine for chaos/e2e runs,
+    not for 1000-node profiles). The static half of the same invariant
+    runs in CI via ``python -m tools.tpflcheck`` (docs/concurrency.md)."""
+
     # --- determinism / TPU ---
     SEED: int | None = None
     """Global seed for reproducible experiments (fork feature)."""
@@ -329,9 +343,16 @@ class Settings:
     @classmethod
     def set_test_settings(cls) -> None:
         """Aggressive timings for tests — parity with utils/utils.py:39-57."""
+        # Profile totality (enforced by tools/tpflcheck's knob lint):
+        # every knob any profile tunes is assigned in ALL profiles, so
+        # switching profiles mid-process can never leak a value from
+        # the previous one (set_scale_settings leaving
+        # AGGREGATION_STALL armed inside a later test run was exactly
+        # this bug class).
         cls.GRPC_TIMEOUT = 0.5
         cls.HEARTBEAT_PERIOD = 0.5
         cls.HEARTBEAT_TIMEOUT = 2.0
+        cls.ELECTION = "vote"
         cls.GOSSIP_PERIOD = 0.0
         cls.TTL = 10
         cls.GOSSIP_MESSAGES_PER_PERIOD = 100
@@ -343,10 +364,16 @@ class Settings:
         cls.SIM_BATCH_WINDOW = 0.05
         cls.VOTE_TIMEOUT = 30.0
         cls.AGGREGATION_TIMEOUT = 30.0
+        # Reference behavior: wait the full timeout, close only on full
+        # coverage; fast early-stop polling suits short test rounds.
+        cls.AGGREGATION_STALL = None
+        cls.ROUND_WAIT_POLL = 0.1
         cls.WAIT_HEARTBEATS_CONVERGENCE = 0.2
+        cls.GOSSIP_METRICS = True
         cls.LOG_LEVEL = "DEBUG"
         cls.ASYNC_LOGGER = False
         cls.FILE_LOGGER = False
+        cls.LOCK_TRACING = False
         # Exactness first in tests: dense payloads (v3 zero-copy layout
         # — still exact), no residual gossip; codec tests opt in
         # explicitly. Zero-copy stays byte-path (INPROC_ZERO_COPY off)
@@ -379,6 +406,7 @@ class Settings:
         cls.GRPC_TIMEOUT = 2.0
         cls.HEARTBEAT_PERIOD = 10.0
         cls.HEARTBEAT_TIMEOUT = 45.0
+        cls.ELECTION = "vote"
         cls.GOSSIP_PERIOD = 1.0
         cls.TTL = 40
         cls.GOSSIP_MESSAGES_PER_PERIOD = 9999999
@@ -386,10 +414,19 @@ class Settings:
         cls.GOSSIP_MODELS_PERIOD = 1.0
         cls.GOSSIP_MODELS_PER_ROUND = 4
         cls.GOSSIP_EXIT_ON_X_EQUAL_ROUNDS = 30
+        cls.TRAIN_SET_SIZE = 4
+        cls.SIM_BATCH_WINDOW = 0.2
         cls.VOTE_TIMEOUT = 1200.0
         cls.AGGREGATION_TIMEOUT = 1200.0
+        cls.AGGREGATION_STALL = None
+        cls.ROUND_WAIT_POLL = 0.5
         cls.WAIT_HEARTBEATS_CONVERGENCE = 4.0
+        cls.GOSSIP_METRICS = True
         cls.LOG_LEVEL = "INFO"
+        cls.ASYNC_LOGGER = True
+        cls.FILE_LOGGER = True
+        cls.WIRE_CHUNK_SIZE = 256 * 1024
+        cls.LOCK_TRACING = False
         # Single-host, handful of nodes: bytes are not the bottleneck —
         # keep the exact dense wire (reference-parity behavior; the v3
         # layout is exact, only the framing differs). By-reference
@@ -424,7 +461,11 @@ class Settings:
         # host); deterministic sortition is the profile default. The
         # GLOBAL default stays "vote" for reference parity.
         cls.ELECTION = "hash"
+        # Knobs this profile never tuned are pinned at their class
+        # defaults (profile totality — see set_test_settings).
+        cls.GRPC_TIMEOUT = 10.0
         cls.GOSSIP_PERIOD = 0.0
+        cls.TTL = 10
         cls.GOSSIP_MESSAGES_PER_PERIOD = 100_000
         cls.AMOUNT_LAST_MESSAGES_SAVED = 100_000
         # 0.25 s (not 0.05): every push tick's delivery runs the
@@ -448,12 +489,17 @@ class Settings:
         # the hub's floor load. 10s matches the standalone profile.
         cls.HEARTBEAT_PERIOD = 10.0
         cls.HEARTBEAT_TIMEOUT = 45.0
+        cls.TRAIN_SET_SIZE = 4
+        cls.SIM_BATCH_WINDOW = 0.2
         cls.VOTE_TIMEOUT = 120.0
         cls.AGGREGATION_TIMEOUT = 120.0
         cls.WAIT_HEARTBEATS_CONVERGENCE = 0.5
+        cls.LOG_LEVEL = "INFO"
         cls.ASYNC_LOGGER = False
         cls.FILE_LOGGER = False
         cls.GOSSIP_METRICS = False
+        cls.WIRE_CHUNK_SIZE = 256 * 1024
+        cls.LOCK_TRACING = False
         # Hundreds of round-result waiters waking 2x/s each is a
         # standing GIL tax on the trainers forming the aggregate they
         # wait for; the event still wakes them INSTANTLY on FullModel

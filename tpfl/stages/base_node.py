@@ -96,10 +96,14 @@ class StartLearningStage(Stage):
         # Diffuse initial weights to direct neighbors that have not
         # announced a model yet (reference :81-112).
         def candidates() -> list[str]:
+            # Snapshot (get_nei_status): command handlers insert
+            # concurrently, and a bare membership scan during insert is
+            # the race the guarded-by lint flags.
+            status = st.get_nei_status()
             return [
                 n
                 for n in node.communication.get_neighbors(only_direct=True)
-                if n not in st.nei_status
+                if n not in status
             ]
 
         # Encode once: params are fixed during init diffusion, and at a
@@ -110,7 +114,7 @@ class StartLearningStage(Stage):
         node.communication.gossip_weights(
             early_stopping_fn=lambda: check_early_stop(node),
             get_candidates_fn=candidates,
-            status_fn=lambda: sorted(st.nei_status),
+            status_fn=lambda: sorted(st.get_nei_status()),
             model_fn=lambda nei: node.communication.build_weights(
                 InitModelCommand.name,
                 st.round if st.round is not None else 0,
@@ -494,9 +498,14 @@ class TrainStage(Stage):
             else:
                 node.learner.set_model(agg_model)
                 if st.round is not None:
-                    st.last_full_model_round = max(
-                        st.last_full_model_round, st.round
-                    )
+                    # Watermark bump is a read-modify-write racing
+                    # FullModelCommand's (gRPC handler pool): both
+                    # serialize under relay_lock or a concurrent max()
+                    # can regress the adopted round.
+                    with st.relay_lock:
+                        st.last_full_model_round = max(
+                            st.last_full_model_round, st.round
+                        )
                     # Register this round's delta-gossip base as the
                     # WIRE ROUND-TRIP of our aggregate, not the exact
                     # params: under a lossy codec a dense receiver holds
@@ -602,10 +611,11 @@ class GossipModelStage(Stage):
         def candidates() -> list[str]:
             if st.round is None or not holds_aggregate():
                 return []
+            status = st.get_nei_status()
             return [
                 n
                 for n in node.communication.get_neighbors(only_direct=True)
-                if st.nei_status.get(n, -1) < st.round
+                if status.get(n, -1) < st.round
             ]
 
         # One encode per (MODEL VERSION, wire form): per-push re-encodes
@@ -635,7 +645,7 @@ class GossipModelStage(Stage):
                 and st.round is not None
                 and st.round > 0
                 and nei not in st.delta_nack_peers
-                and st.nei_status.get(nei, -2) == st.round - 1
+                and st.nei_status_of(nei, -2) == st.round - 1
             ):
                 base = st.wire_bases.get(st.round - 1)  # (fp, params)
             key = "delta" if base is not None else "dense"
@@ -674,7 +684,7 @@ class GossipModelStage(Stage):
         node.communication.gossip_weights(
             early_stopping_fn=lambda: check_early_stop(node) or not candidates(),
             get_candidates_fn=candidates,
-            status_fn=lambda: sorted(st.nei_status.items()),
+            status_fn=lambda: sorted(st.get_nei_status().items()),
             model_fn=model_for,
         )
         return RoundFinishedStage
