@@ -47,6 +47,12 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
   wire vs the scale profile's "quant8+zlib" + residual broadcast),
   reporting total payload bytes, steady loss for both runs, and the
   ≥4x-bytes / ≤2%-loss acceptance booleans.
+- extra.telemetry_*: telemetry tier (management/telemetry + tracing) —
+  trace-id mint determinism for a fixed seed, a seeded 4-node digits
+  A/B with hop-level tracing off vs on (must cost <5% rounds/sec, and
+  the traced run's spans must reconstruct complete payload hop paths
+  across all nodes via tools/traceview.py), and a registry fold sanity
+  report.
 - extra.chaos_*: chaos tier (communication/faults.py) —
   chaos_determinism drives a fixed message schedule through the seeded
   FaultInjector twice and reports per-round delivered/dropped counts
@@ -634,6 +640,146 @@ def _analysis_tier(extra: dict) -> None:
             Settings.restore(snap)
     except Exception as e:
         extra["analysis_error"] = str(e)[:200]
+
+
+def _telemetry_tier(extra: dict) -> None:
+    """Telemetry tier (management/telemetry + tracing). Three reports:
+
+    - extra.telemetry_determinism: trace-id minting is a pure function
+      of (seed, node, ordinal) — two mint sequences for the same seed
+      must be identical, and a different seed must diverge.
+    - extra.telemetry_ab: the same seeded 4-node digits federation run
+      with TELEMETRY_ENABLED off and on — the traced run must cost
+      <5% rounds/sec, and its exported spans must reconstruct complete
+      payload hop paths (encode on one node -> decode/fold on another)
+      via tools.traceview.
+    - extra.telemetry_registry: registry fold sanity on the traced run
+      (transport counters present, fold wall-time).
+    """
+    from tpfl.management import tracing
+    from tpfl.settings import Settings
+
+    try:
+        # (a) Deterministic minting under a fixed seed.
+        snap_seed = Settings.SEED
+        try:
+            Settings.SEED = 4242
+            tracing.reset()
+            first = [tracing.mint("bench-node") for _ in range(8)]
+            tracing.reset()
+            second = [tracing.mint("bench-node") for _ in range(8)]
+            Settings.SEED = 4243
+            tracing.reset()
+            other = [tracing.mint("bench-node") for _ in range(8)]
+        finally:
+            Settings.SEED = snap_seed
+            tracing.reset()
+        extra["telemetry_determinism"] = {
+            "seed": 4242,
+            "identical": first == second,
+            "seed_sensitive": first != other,
+            "sample": first[0],
+        }
+
+        # (b) Overhead A/B + timeline completeness.
+        snap = Settings.snapshot()
+        try:
+            from tpfl.management.logger import logger as _logger
+            from tpfl.management.telemetry import flight
+            from tools.traceview import build_timeline, summarize
+
+            Settings.set_test_settings()
+            Settings.LOG_LEVEL = "ERROR"
+            _logger.set_level("ERROR")
+            Settings.ELECTION = "hash"  # n <= TRAIN_SET_SIZE: all elected
+            Settings.SEED = 4242
+
+            def run(traced: bool, tag: str) -> dict:
+                from tpfl.learning.dataset import (
+                    RandomIIDPartitionStrategy,
+                    synthetic_mnist,
+                )
+                from tpfl.models import create_model
+                from tpfl.node import Node
+                from tpfl.utils import wait_convergence, wait_to_finish
+
+                Settings.TELEMETRY_ENABLED = traced
+                flight.clear()
+                tracing.reset()
+                n, rounds = 4, 5
+                ds = synthetic_mnist(
+                    n_train=150 * n, n_test=30, seed=0, noise=0.6
+                )
+                parts = ds.generate_partitions(
+                    n, RandomIIDPartitionStrategy, seed=1
+                )
+                nodes = [
+                    Node(
+                        create_model("mlp", (28, 28), seed=7, hidden_sizes=(32,)),
+                        parts[i],
+                        addr=f"{tag}-{i}",  # pinned: seeded data order
+                        learning_rate=0.05,
+                        batch_size=32,
+                    )
+                    for i in range(n)
+                ]
+                for nd in nodes:
+                    nd.start()
+                try:
+                    for nd in nodes[1:]:
+                        nodes[0].connect(nd.addr)
+                    wait_convergence(nodes, n - 1, only_direct=False, wait=10)
+                    t0 = time.monotonic()
+                    nodes[0].set_start_learning(rounds=rounds, epochs=1)
+                    wait_to_finish(nodes, timeout=240)
+                    elapsed = time.monotonic() - t0
+                finally:
+                    for nd in nodes:
+                        nd.stop()
+                out = {
+                    "rounds": rounds,
+                    "elapsed_s": round(elapsed, 2),
+                    "rounds_per_s": round(rounds / elapsed, 3),
+                }
+                if traced:
+                    out["timeline"] = summarize(
+                        build_timeline(tracing.export())
+                    )
+                return out
+
+            run(False, "tele-warm")  # discarded: pays the jit warmup
+            off = run(False, "tele-off")
+            on = run(True, "tele-on")
+            overhead = 1.0 - on["rounds_per_s"] / max(off["rounds_per_s"], 1e-9)
+            tl = on.pop("timeline")
+            extra["telemetry_ab"] = {
+                "untraced": off,
+                "traced": on,
+                "overhead_frac": round(overhead, 4),
+                "within_5pct_budget": bool(overhead < 0.05),
+                "timeline": tl,
+                "hop_paths_reconstructed": bool(
+                    tl["complete_traces"] > 0
+                    and len(tl["nodes"]) == 4
+                ),
+            }
+
+            t0 = time.monotonic()
+            folded = _logger.metrics.fold()
+            extra["telemetry_registry"] = {
+                "fold_wall_ms": round((time.monotonic() - t0) * 1e3, 2),
+                "counter_series": len(folded["counters"]),
+                "gauge_series": len(folded["gauges"]),
+                "histogram_series": len(folded["histograms"]),
+                "has_transport_counters": any(
+                    k[0] == "tpfl_transport_sends_total"
+                    for k in folded["counters"]
+                ),
+            }
+        finally:
+            Settings.restore(snap)
+    except Exception as e:
+        extra["telemetry_error"] = str(e)[:200]
 
 
 def main() -> None:
@@ -1280,6 +1426,11 @@ def main() -> None:
     # Analysis tier: tpflcheck suite wall-time + lock-traced federation
     # A/B (extra.analysis_static / extra.analysis_lock_trace).
     _analysis_tier(extra)
+
+    # Telemetry tier: trace-id determinism, tracing-enabled overhead
+    # A/B + hop-path reconstruction, registry fold sanity
+    # (extra.telemetry_determinism / telemetry_ab / telemetry_registry).
+    _telemetry_tier(extra)
 
     # Only quantitative anchor in the reference: 2-round MNIST e2e must
     # fit in 240 s (node_test.py:105) -> 0.00833 rounds/s floor.

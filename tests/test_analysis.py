@@ -32,6 +32,7 @@ from tools.tpflcheck import (  # noqa: E402
     check_layers,
     check_locks,
     check_threads,
+    check_trace,
     run_all,
 )
 
@@ -330,6 +331,37 @@ def test_threads_fixture(tmp_path):
     """
     root2 = _mini_repo(tmp_path / "ok", {"tpfl/runner.py": good})
     assert check_threads(root2) == []
+
+
+def test_trace_fixture(tmp_path):
+    bad = """\
+        import logging
+        import time
+
+        def stamp():
+            logging.info("starting")
+            return time.time()
+    """
+    root = _mini_repo(tmp_path, {"tpfl/timer.py": bad})
+    found = check_trace(root)
+    assert any("time.time()" in v.message for v in found), [
+        v.render() for v in found
+    ]
+    assert any("logging.info" in v.message for v in found)
+    good = """\
+        import time
+
+        # a comment saying time.time() must not trip the lint
+
+        def stamp():
+            '''neither does a docstring naming time.time() or logging.info'''
+            return time.monotonic()
+    """
+    root2 = _mini_repo(tmp_path / "ok", {"tpfl/timer.py": good})
+    assert check_trace(root2) == []
+    # The management layer is exempt — it implements the telemetry.
+    root3 = _mini_repo(tmp_path / "mgmt", {"tpfl/management/anchor.py": bad})
+    assert check_trace(root3) == []
 
 
 # --- 3. runtime: TracedLock + traced chaos federation --------------------

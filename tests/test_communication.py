@@ -120,7 +120,7 @@ def test_weights_dispatch(protocol_class):
     got = {}
     b.add_command(
         "model",
-        lambda source, round, weights, contributors, num_samples: got.update(
+        lambda source, round, weights, contributors, num_samples, **kw: got.update(
             dict(w=weights, c=contributors, n=num_samples, r=round)
         ),
     )
@@ -198,7 +198,7 @@ def test_gossip_weights_until_early_stop(protocol_class):
     received = []
     b.add_command(
         "part",
-        lambda source, round, weights, contributors, num_samples: received.append(
+        lambda source, round, weights, contributors, num_samples, **kw: received.append(
             weights
         ),
     )
@@ -365,7 +365,9 @@ def test_digest_merge_does_not_resurrect_dead_peers():
     from tpfl.communication.neighbors import Neighbors
 
     n = Neighbors("me")
-    now = _time.time()
+    # Stamps ride the MONOTONIC clock (heartbeater.py: only relative
+    # ages cross the wire; absolute stamps are node-local, NTP-immune).
+    now = _time.monotonic()
     n.merge_digest(
         [("stale-peer", now - 500.0), ("recent-peer", now - 3.0)],
         max_age=120.0,
@@ -626,7 +628,7 @@ def test_corruption_rejected_by_chunk_crc_and_retried():
         got = []
         b.add_command(
             "model",
-            lambda source, round, weights, contributors, num_samples: got.append(
+            lambda source, round, weights, contributors, num_samples, **kw: got.append(
                 weights
             ),
         )

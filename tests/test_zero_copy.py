@@ -144,7 +144,7 @@ def test_inmemory_weights_exchange_e2e(zero_copy):
         received = {}
         done = threading.Event()
 
-        def handler(source, round, weights, contributors, num_samples):
+        def handler(source, round, weights, contributors, num_samples, **kwargs):
             model = base.build_copy(params=weights)
             model.apply_to_params(lambda x: x * 0.0)  # receiver mutates
             received["model"] = model

@@ -9,6 +9,9 @@ framework, shared file-walking / waiver / reporting machinery
     layers    SURVEY layer map (no upward module-level imports)
     knobs     Settings knob existence / profile totality / docs sync
     threads   thread-lifecycle hygiene (name= + daemon= everywhere)
+    trace     timing/logging-path lint (no time.time() or raw logging
+              outside tpfl/management — spans/metrics are the only
+              sanctioned timing path; see docs/observability.md)
     wire      codec-registry, copy-discipline and RPC-path lints
               (the original wirecheck trio)
 
@@ -36,6 +39,7 @@ from tools.tpflcheck.knobs import check_knobs
 from tools.tpflcheck.layers import check_layers
 from tools.tpflcheck.locks import check_locks, lock_edges
 from tools.tpflcheck.threads import check_threads
+from tools.tpflcheck.trace import check_trace
 
 __all__ = [
     "Violation",
@@ -45,6 +49,7 @@ __all__ = [
     "check_layers",
     "check_locks",
     "check_threads",
+    "check_trace",
     "lock_edges",
     "run_all",
     "wire",
@@ -64,6 +69,7 @@ def run_all(
     knob_violations, warnings = check_knobs(root)
     violations += knob_violations
     violations += check_threads(root)
+    violations += check_trace(root)
     violations += wire.violations(root)
 
     waivers = load_waivers(root)

@@ -61,6 +61,10 @@ GUARDED_MODULES = (
     "tpfl/communication/resilience.py",
     "tpfl/learning/bufferpool.py",
     "tpfl/management/metric_storage.py",
+    "tpfl/management/logger.py",
+    "tpfl/management/node_monitor.py",
+    "tpfl/management/telemetry.py",
+    "tpfl/management/tracing.py",
     "tpfl/learning/aggregators/aggregator.py",
 )
 

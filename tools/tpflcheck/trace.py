@@ -1,0 +1,78 @@
+"""Timing/logging-path lint: spans and metrics are the only sanctioned
+timing path.
+
+Two invariants over ``tpfl/`` (the management layer is exempt — it IS
+the telemetry implementation and owns the wall-clock anchor):
+
+1. **No ``time.time()``** — every duration, deadline, and stamp in the
+   protocol must come from ``time.monotonic()`` (NTP-step immunity —
+   the aggregator stall clock and round deadlines moved first; this
+   lint keeps the rest from regressing) or flow through the tracing
+   spans in :mod:`tpfl.management.tracing`, which timestamp
+   monotonically and carry the process wall anchor for cross-process
+   merges.
+
+2. **No raw ``logging`` calls** — ``logging.getLogger``/``logging.info``
+   etc. bypass the framework logger's routing (node tagging, async
+   queue, web push) and the metrics registry. Everything observable
+   goes through ``tpfl.management.logger`` / ``logger.metrics``.
+
+AST-based (docstrings and comments mentioning ``time.time()`` don't
+count — only actual call sites).
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+
+from tools.tpflcheck.core import Violation, py_files, rel, repo_root
+
+#: Modules exempt from the lint: the management layer implements the
+#: telemetry/logging machinery itself (the flight recorder's wall
+#: anchor is the one sanctioned ``time.time()`` call).
+ALLOWED_PREFIX = "tpfl/management/"
+
+_LOGGING_CALLS = {
+    "debug", "info", "warning", "error", "critical", "exception",
+    "log", "getLogger", "basicConfig",
+}
+
+
+def check_trace(repo: "pathlib.Path | None" = None) -> list[Violation]:
+    root = repo_root(repo)
+    out: list[Violation] = []
+    for path in py_files(root):
+        r = rel(root, path)
+        if r.startswith(ALLOWED_PREFIX):
+            continue
+        tree = ast.parse(path.read_text(encoding="utf-8"))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not (
+                isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name)
+            ):
+                continue
+            if fn.value.id == "time" and fn.attr == "time":
+                out.append(
+                    Violation(
+                        "trace", r, node.lineno,
+                        "time.time() outside tpfl/management — use "
+                        "time.monotonic() (NTP-step immune) or a tracing "
+                        "span (tpfl.management.tracing)",
+                        f"trace:{r}:{node.lineno}",
+                    )
+                )
+            elif fn.value.id == "logging" and fn.attr in _LOGGING_CALLS:
+                out.append(
+                    Violation(
+                        "trace", r, node.lineno,
+                        f"raw logging.{fn.attr}() outside tpfl/management — "
+                        "route through tpfl.management.logger (node "
+                        "tagging, async queue, metrics registry)",
+                        f"trace:{r}:{node.lineno}",
+                    )
+                )
+    return out

@@ -1,0 +1,198 @@
+"""traceview — reconstruct round timelines from tpfl telemetry dumps.
+
+Input: flight-recorder dumps (``flight-<node>-<reason>.json``, written
+by ``Node.stop()`` / the chaos harness into
+``Settings.TELEMETRY_DUMP_DIR``) and/or in-process span exports
+(``tpfl.management.tracing.export()``). Every entry is a span
+(``{"kind": "span", "name", "node", "trace", "t0", "t1", ...}``) or an
+event (``{"kind": "event", ..., "t"}``); timestamps are
+``time.monotonic()`` seconds with a per-process ``wall_anchor`` in the
+dump envelope, so dumps from different processes merge onto one
+wall-clock axis.
+
+Output: per-trace timelines — for each model payload's 16-byte trace
+id, the ordered chain of spans it crossed
+(``encode@a → send@a→b → recv@b → decode@b → fold@b``), across every
+node that handled it. This is the view no single node ever has: the
+gossip hops, retries, breaker trips, chunk streams, decodes and
+aggregation folds of one payload, stitched back together.
+
+Run::
+
+    python -m tools.traceview logs/flight-*.json
+    python -m tools.traceview --summary dumps/
+
+Pure functions (:func:`build_timeline`, :func:`hop_path`) are the
+test/bench surface; the CLI is a thin formatter over them.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+from typing import Any, Iterable
+
+
+def load(paths: Iterable[str]) -> list[dict]:
+    """Load spans/events from dump files (or directories of them).
+
+    Accepts flight-recorder dump envelopes (``{"node", "reason",
+    "wall_anchor", "events": [...]}``) and bare JSON lists of entries.
+    Each entry gains a wall-clock timestamp (``wt``) from its dump's
+    anchor so cross-process entries order correctly."""
+    entries: list[dict] = []
+    files: list[pathlib.Path] = []
+    for p in paths:
+        path = pathlib.Path(p)
+        if path.is_dir():
+            files.extend(sorted(path.glob("flight-*.json")))
+        else:
+            files.append(path)
+    for path in files:
+        doc = json.loads(path.read_text(encoding="utf-8"))
+        if isinstance(doc, dict):
+            anchor = float(doc.get("wall_anchor", 0.0))
+            batch = doc.get("events", [])
+        else:
+            anchor, batch = 0.0, doc
+        for e in batch:
+            e = dict(e)
+            e["wt"] = anchor + float(e.get("t0", e.get("t", 0.0)))
+            entries.append(e)
+    return entries
+
+
+def _stamp(e: dict) -> float:
+    return float(e.get("wt", e.get("t0", e.get("t", 0.0))))
+
+
+def build_timeline(entries: Iterable[dict]) -> dict[str, list[dict]]:
+    """Group spans/events by trace id, each trace's entries in time
+    order. Entries without a trace id (stage spans, system events) are
+    grouped under ``""`` — the per-node backbone the payload traces
+    hang between. Duplicate spans are dropped by span id: a node that
+    dumped twice (crash dump, then its stop dump) contributes each
+    span once."""
+    timeline: dict[str, list[dict]] = {}
+    seen: set = set()
+    for e in entries:
+        # Span ids are unique per node; events dedup on their full
+        # identity (identical copies across overlapping dumps).
+        sid = e.get("span")
+        key = (
+            (e.get("node"), sid)
+            if sid is not None
+            else (e.get("node"), e.get("name"), e.get("trace"), e.get("t"))
+        )
+        if key in seen:
+            continue
+        seen.add(key)
+        timeline.setdefault(str(e.get("trace", "")), []).append(dict(e))
+    for chain in timeline.values():
+        chain.sort(key=_stamp)
+    return timeline
+
+
+def hop_path(chain: list[dict]) -> list[str]:
+    """A trace's condensed hop chain: ``op@node`` (send shows the
+    peer: ``send@a->b``), retries/events included in order."""
+    out: list[str] = []
+    for e in chain:
+        name, node = str(e.get("name", "?")), str(e.get("node", "?"))
+        if name in ("send", "retry") and e.get("peer"):
+            out.append(f"{name}@{node}->{e['peer']}")
+        else:
+            out.append(f"{name}@{node}")
+    return out
+
+
+def trace_complete(chain: list[dict]) -> bool:
+    """A payload trace is reconstructable end-to-end when it shows the
+    encode AND a consuming hop (decode or fold) — on a different node
+    unless the federation is single-node."""
+    names = {str(e.get("name", "")) for e in chain}
+    if "encode" not in names:
+        return False
+    if not ({"decode", "fold"} & names):
+        return False
+    encode_nodes = {
+        e.get("node") for e in chain if e.get("name") == "encode"
+    }
+    consume_nodes = {
+        e.get("node") for e in chain if e.get("name") in ("decode", "fold")
+    }
+    return bool(consume_nodes - encode_nodes) or encode_nodes == consume_nodes
+
+
+def summarize(timeline: dict[str, list[dict]]) -> dict[str, Any]:
+    traced = {t: c for t, c in timeline.items() if t}
+    complete = {t: c for t, c in traced.items() if trace_complete(c)}
+    nodes = sorted(
+        {str(e.get("node", "?")) for c in timeline.values() for e in c}
+    )
+    return {
+        "traces": len(traced),
+        "complete_traces": len(complete),
+        "nodes": nodes,
+        "entries": sum(len(c) for c in timeline.values()),
+    }
+
+
+def render(timeline: dict[str, list[dict]], limit: int = 0) -> str:
+    lines: list[str] = []
+    s = summarize(timeline)
+    lines.append(
+        f"{s['entries']} entries, {s['traces']} payload traces "
+        f"({s['complete_traces']} complete) across {len(s['nodes'])} "
+        f"nodes: {', '.join(s['nodes'])}"
+    )
+    shown = 0
+    for trace in sorted(t for t in timeline if t):
+        chain = timeline[trace]
+        if limit and shown >= limit:
+            lines.append(f"... ({s['traces'] - shown} more traces)")
+            break
+        shown += 1
+        t0 = _stamp(chain[0])
+        mark = "✓" if trace_complete(chain) else "…"
+        lines.append(f"\ntrace {trace[:16]} {mark}")
+        for e in chain:
+            dt = _stamp(e) - t0
+            name, node = str(e.get("name", "?")), str(e.get("node", "?"))
+            dur = ""
+            if "t1" in e and "t0" in e:
+                dur = f"  ({(float(e['t1']) - float(e['t0'])) * 1e3:.2f} ms)"
+            peer = f" -> {e['peer']}" if e.get("peer") else ""
+            err = f"  ERROR {e['error']}" if e.get("error") else ""
+            lines.append(
+                f"  +{dt * 1e3:9.2f} ms  {name:<12} {node}{peer}{dur}{err}"
+            )
+    return "\n".join(lines)
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Reconstruct tpfl round timelines from telemetry dumps"
+    )
+    ap.add_argument("paths", nargs="+", help="dump files or directories")
+    ap.add_argument(
+        "--summary", action="store_true",
+        help="counts only (no per-trace chains)",
+    )
+    ap.add_argument(
+        "--limit", type=int, default=20,
+        help="max traces to render (0 = all)",
+    )
+    args = ap.parse_args(argv)
+    timeline = build_timeline(load(args.paths))
+    if args.summary:
+        print(json.dumps(summarize(timeline), indent=2))
+    else:
+        print(render(timeline, limit=args.limit))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

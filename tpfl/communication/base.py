@@ -28,6 +28,7 @@ from tpfl.exceptions import (
     CommunicationError,
     NeighborNotConnectedError,
 )
+from tpfl.management import tracing
 from tpfl.management.logger import logger
 from tpfl.settings import Settings
 
@@ -199,7 +200,15 @@ class ThreadedCommunicationProtocol(CommunicationProtocol):
         num_samples: int = 0,
     ) -> Message:
         """``serialized_model``: encoded payload bytes, or — on a
-        zero-copy in-process transport — an ``InprocModelRef``."""
+        zero-copy in-process transport — an ``InprocModelRef``. The
+        payload's embedded trace id (if telemetry minted one at encode
+        time) is mirrored onto the transport envelope so hop spans can
+        tag without re-parsing payload bytes downstream."""
+        trace = (
+            tracing.payload_trace_id(serialized_model)
+            if Settings.TELEMETRY_ENABLED
+            else ""
+        )
         return Message(
             source=self._addr,
             cmd=cmd,
@@ -207,6 +216,7 @@ class ThreadedCommunicationProtocol(CommunicationProtocol):
             payload=serialized_model,
             contributors=list(contributors or []),
             num_samples=num_samples,
+            trace=trace,
         )
 
     def model_payload(self, model: Any, delta_base: Optional[tuple] = None) -> Any:
@@ -222,11 +232,28 @@ class ThreadedCommunicationProtocol(CommunicationProtocol):
         identical to pre-zero-copy behavior. ``delta_base`` requests a
         residual payload and is ignored on the by-reference path (a ref
         is already exact and costs nothing)."""
-        if self.ZERO_COPY_INPROC and Settings.INPROC_ZERO_COPY:
-            return model.as_ref()
-        if delta_base is not None:
-            return model.encode_parameters(delta_base=delta_base)
-        return model.encode_parameters()
+        # Trace minting happens HERE — the first encode of a payload is
+        # where its identity is born; every later hop (relays forward
+        # the bytes verbatim) carries the same id.
+        tid = tracing.mint(self._addr) if Settings.TELEMETRY_ENABLED else None
+        with tracing.maybe_span(
+            "encode", self._addr, trace=tid or "",
+            byref=bool(self.ZERO_COPY_INPROC and Settings.INPROC_ZERO_COPY),
+        ) as span:
+            if self.ZERO_COPY_INPROC and Settings.INPROC_ZERO_COPY:
+                return model.as_ref(trace=tid or "")
+            if delta_base is not None:
+                payload = model.encode_parameters(
+                    delta_base=delta_base, trace_id=tid
+                )
+            else:
+                payload = model.encode_parameters(trace_id=tid)
+            span.set(bytes=len(payload))
+            logger.metrics.counter(
+                "tpfl_payload_bytes_total", float(len(payload)),
+                labels={"node": self._addr},
+            )
+            return payload
 
     def send(
         self,
@@ -283,7 +310,11 @@ class ThreadedCommunicationProtocol(CommunicationProtocol):
                 return
         try:
             msg.via = self._addr  # mark the hop (flood skip-back)
-            attempts = self._send_with_retry(nei, conn, msg)
+            with tracing.maybe_span(
+                "send", self._addr, trace=msg.trace, peer=nei, cmd=msg.cmd,
+            ) as span:
+                attempts = self._send_with_retry(nei, conn, msg)
+                span.set(attempts=attempts, ok=True)
         except Exception as e:
             # Unlike the reference's on-first-error eviction
             # (grpc_client.py:176-183), a failed send only counts
@@ -325,6 +356,10 @@ class ThreadedCommunicationProtocol(CommunicationProtocol):
                 if attempt + 1 >= attempts:
                     raise
                 delay = backoff_delay(attempt, self._retry_rng)
+                tracing.event(
+                    "retry", self._addr, trace=msg.trace, peer=nei,
+                    cmd=msg.cmd, attempt=attempt + 1, delay=round(delay, 4),
+                )
                 logger.debug(
                     self._addr,
                     f"Send to {nei} failed ({e}); retry "
@@ -486,13 +521,22 @@ class ThreadedCommunicationProtocol(CommunicationProtocol):
             return
         try:
             if msg.is_weights:
-                handler(
-                    source=msg.source,
-                    round=msg.round,
-                    weights=msg.payload,
-                    contributors=msg.contributors,
-                    num_samples=msg.num_samples,
-                )
+                # Weights hops are the traced path: the recv span
+                # brackets handler execution (decode + fold included),
+                # and the payload's trace id flows to the handler so
+                # its inner spans join the same timeline.
+                with tracing.maybe_span(
+                    "recv", self._addr, trace=msg.trace,
+                    peer=msg.source, cmd=msg.cmd,
+                ):
+                    handler(
+                        source=msg.source,
+                        round=msg.round,
+                        weights=msg.payload,
+                        contributors=msg.contributors,
+                        num_samples=msg.num_samples,
+                        trace=msg.trace,
+                    )
             else:
                 handler(source=msg.source, round=msg.round, args=msg.args)
         except Exception as e:

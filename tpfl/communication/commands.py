@@ -18,6 +18,7 @@ import threading
 from abc import ABC, abstractmethod
 from typing import Any, Callable, Optional, TYPE_CHECKING
 
+from tpfl.management import tracing
 from tpfl.management.logger import logger
 
 if TYPE_CHECKING:
@@ -313,7 +314,11 @@ class InitModelCommand(NodeCommand):
             )
             return
         try:
-            self.node.learner.set_model(weights)
+            with tracing.maybe_span(
+                "decode", st.addr, trace=kwargs.get("trace", ""),
+                cmd=self.name, peer=source,
+            ):
+                self.node.learner.set_model(weights)
         except Exception as e:
             logger.error(st.addr, f"InitModel decode failed: {e}")
             return
@@ -375,12 +380,20 @@ class PartialModelCommand(NodeCommand):
         if not st.train_set:
             logger.debug(st.addr, f"PartialModel from {source} dropped (no train set)")
             return
+        trace = kwargs.get("trace", "")
         try:
-            model = self.node.learner.get_model().build_copy(params=weights)
+            with tracing.maybe_span(
+                "decode", st.addr, trace=trace, cmd=self.name, peer=source,
+            ):
+                model = self.node.learner.get_model().build_copy(params=weights)
         except Exception as e:
             logger.error(st.addr, f"PartialModel decode failed: {e}")
             return
-        covered = self.node.aggregator.add_model(model)
+        with tracing.maybe_span(
+            "fold", st.addr, trace=trace, peer=source,
+        ) as fold_span:
+            covered = self.node.aggregator.add_model(model)
+            fold_span.set(covered=len(covered))
         if covered:
             st.set_models_aggregated(st.addr, covered)
             send_models_aggregated(self.node, covered)
@@ -442,7 +455,11 @@ class FullModelCommand(NodeCommand):
         if round < st.round:
             return
         try:
-            self.node.learner.set_model(weights)
+            with tracing.maybe_span(
+                "decode", st.addr, trace=kwargs.get("trace", ""),
+                cmd=self.name, peer=source,
+            ):
+                self.node.learner.set_model(weights)
         except DeltaBaseMismatchError as e:
             # Recoverable codec negotiation: tell the sender we lack the
             # base; it re-sends dense (Settings.WIRE_DELTA docs).

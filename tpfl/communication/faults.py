@@ -219,6 +219,15 @@ class FaultInjector:
     def crash(self, addr: str) -> None:
         with self._lock:
             self._manual_down.add(addr)
+        # Post-mortem hook: an injected crash is exactly the failure
+        # the flight recorder exists for — record it and flush the
+        # victim's ring (a JSON dump lands in
+        # Settings.TELEMETRY_DUMP_DIR when set, traceview-readable).
+        from tpfl.management import tracing
+        from tpfl.management.telemetry import flight
+
+        tracing.event("crash_injected", addr)
+        flight.dump(addr, "crash")
 
     def revive(self, addr: str) -> None:
         with self._lock:

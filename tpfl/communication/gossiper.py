@@ -121,6 +121,19 @@ class Gossiper(threading.Thread):
                 neighbors = [
                     n for n in self._get_neighbors(True) if self._link_ok(n)
                 ]
+                # Flood-pressure observability: how deep the relay
+                # backlog ran when this batch was cut (a hub whose
+                # pending gauge grows round-over-round is saturating).
+                with self._pending_lock:
+                    backlog = len(self._pending) + len(self._priority)
+                logger.metrics.gauge(
+                    "tpfl_gossip_pending", float(backlog),
+                    labels={"node": self._addr},
+                )
+                logger.metrics.counter(
+                    "tpfl_gossip_flooded_total", float(len(batch)),
+                    labels={"node": self._addr},
+                )
             for msg in batch:
                 # Capture before sending: the transport overwrites
                 # msg.via with our own address at dispatch time.

@@ -316,9 +316,17 @@ class GrpcCommunicationProtocol(ThreadedCommunicationProtocol):
     def _transport_send(self, addr: str, conn: Any, msg: Message) -> None:
         data = msg.to_bytes()
         chunk = Settings.WIRE_CHUNK_SIZE
+        logger.metrics.counter(
+            "tpfl_wire_bytes_total", float(len(data)),
+            labels={"node": self._addr},
+        )
         try:
             if chunk and len(data) > chunk and "SendStream" in conn["stubs"]:
                 n_chunks = -(-len(data) // chunk)
+                logger.metrics.counter(
+                    "tpfl_wire_chunks_total", float(n_chunks),
+                    labels={"node": self._addr},
+                )
                 # Timeout scales with the transfer: the unary GRPC_TIMEOUT
                 # is tuned for control messages, not a multi-MB model.
                 resp = conn["stubs"]["SendStream"](

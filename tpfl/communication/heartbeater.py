@@ -55,8 +55,13 @@ class Heartbeater(threading.Thread):
         """Incoming beat: refresh the sender, merge its digest.
 
         ``args``: ``[sender_ts, addr_1, age_1, addr_2, age_2, ...]`` —
-        the sender's peer table as (address, seconds-since-heard)."""
-        now = time.time()
+        the sender's peer table as (address, seconds-since-heard).
+        Stamps are ``time.monotonic()`` — only relative AGES cross the
+        wire, every absolute stamp is produced and consumed on this
+        node, so the monotonic clock is both sufficient and NTP-step
+        immune (and the tpflcheck ``trace`` lint bans ``time.time()``
+        outside management)."""
+        now = time.monotonic()
         entries = [(source, now)]
         it = iter(args[1:])
         for addr, age in zip(it, it):
@@ -71,7 +76,7 @@ class Heartbeater(threading.Thread):
         )
 
     def _digest(self) -> list[str]:
-        now = time.time()
+        now = time.monotonic()
         args = [str(now)]
         # One locked snapshot (digest_entries), not a live-entry walk:
         # last_beat is table-lock-guarded state and writers refresh it
@@ -91,9 +96,17 @@ class Heartbeater(threading.Thread):
                 )
             except Exception as e:
                 logger.debug(self._addr, f"Heartbeat broadcast failed: {e}")
+            logger.metrics.counter(
+                "tpfl_heartbeats_total", labels={"node": self._addr}
+            )
             evicted = self._neighbors.evict_stale(Settings.HEARTBEAT_TIMEOUT)
             for a in evicted:
                 logger.info(self._addr, f"Heartbeat timeout, evicted {a}")
+            if evicted:
+                logger.metrics.counter(
+                    "tpfl_heartbeat_evictions_total", float(len(evicted)),
+                    labels={"node": self._addr},
+                )
             if self._probe is not None:
                 try:
                     self._probe()

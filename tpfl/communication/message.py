@@ -43,6 +43,12 @@ class Message:
     # traffic is otherwise leaves echoing messages straight back at the
     # hub. Set by the transport at send time.
     via: str = ""
+    # Hop-tracing id (tpfl.management.tracing): mirrors the trace id
+    # embedded in a weights payload so the shared send/receive paths
+    # can tag hop spans without touching payload bytes. Empty when
+    # telemetry is off or the message carries no traced payload;
+    # pre-telemetry peers ignore the extra wire key.
+    trace: str = ""
 
     @property
     def is_weights(self) -> bool:
@@ -80,6 +86,7 @@ class Message:
                 "c": self.contributors,
                 "n": self.num_samples,
                 "v": self.via,
+                "t": self.trace,
             },
             use_bin_type=True,
         )
@@ -98,4 +105,5 @@ class Message:
             contributors=list(d["c"]),
             num_samples=d["n"],
             via=d.get("v", ""),
+            trace=d.get("t", ""),
         )

@@ -72,7 +72,7 @@ class Neighbors:
         """
         if addr == self.self_addr:
             return False
-        stamp = beat_time if beat_time is not None else time.time()
+        stamp = beat_time if beat_time is not None else time.monotonic()
         with self._lock:
             existing = self._table.get(addr)
             if existing is not None:
@@ -135,7 +135,7 @@ class Neighbors:
         already established."""
         if addr == self.self_addr:
             return
-        t = beat_time if beat_time is not None else time.time()
+        t = beat_time if beat_time is not None else time.monotonic()
         with self._lock:
             nei = self._table.get(addr)
             if nei is not None:
@@ -154,7 +154,7 @@ class Neighbors:
         freshness. ``max_age``: unknown entries already older than this
         are dropped — re-learning a peer we (or anyone) evicted, with a
         fresh timestamp, would resurrect dead nodes network-wide."""
-        now = time.time()
+        now = time.monotonic()
         unknown: list[tuple[str, float]] = []
         with self._lock:
             for addr, beat_time in entries:
@@ -234,7 +234,7 @@ class Neighbors:
         lines. At 500-node scale, digest entries hovering near the
         timeout previously churned through add→evict→log cycles whose
         logging alone starved a single-core host."""
-        now = time.time()
+        now = time.monotonic()
         with self._lock:
             stale_direct = [
                 a

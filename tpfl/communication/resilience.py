@@ -27,6 +27,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from tpfl.concurrency import make_lock
+from tpfl.management import tracing
 from tpfl.management.logger import logger
 from tpfl.settings import Settings
 
@@ -119,6 +120,9 @@ class CircuitBreaker:
         logger.transport_metrics.record_send(self._addr, addr, False, attempts)
         if opened:
             logger.transport_metrics.record_breaker(self._addr, addr, "open")
+            # Flight-recorder event: a breaker trip is exactly the kind
+            # of thing a post-mortem needs a timestamped record of.
+            tracing.event("breaker_open", self._addr, peer=addr)
         return opened
 
     # --- liveness / probe hooks ---
@@ -136,6 +140,7 @@ class CircuitBreaker:
         if was_open:
             logger.info(self._addr, f"Circuit to {addr} closed (peer alive again)")
             logger.transport_metrics.record_breaker(self._addr, addr, "closed")
+            tracing.event("breaker_close", self._addr, peer=addr)
 
     def probe_due(self, now: Optional[float] = None) -> list[str]:
         """Open peers due a half-open reconnect probe; marks them

@@ -150,7 +150,7 @@ def digits(args: argparse.Namespace) -> list[Node]:
             )
         print(f"jax profiler trace written to {args.profile}")
         return result
-    start = time.time()
+    start = time.monotonic()
     Settings.set_standalone_settings()
 
     n = args.nodes
@@ -205,7 +205,7 @@ def digits(args: argparse.Namespace) -> list[Node]:
         for nd in nodes:
             nd.stop()
         if args.measure_time:
-            print(f"--- {time.time() - start:.1f} seconds ---")
+            print(f"--- {time.monotonic() - start:.1f} seconds ---")
     return nodes
 
 

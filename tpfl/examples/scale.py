@@ -131,7 +131,7 @@ def scale(args: argparse.Namespace) -> dict[str, float]:
         )
         for i in range(n)
     ]
-    t_start = time.time()
+    t_start = time.monotonic()
     for nd in nodes:
         nd.start()
     try:
@@ -146,12 +146,12 @@ def scale(args: argparse.Namespace) -> dict[str, float]:
         # Full-view discovery rides the heartbeat flood: every node must
         # hear N-1 others through the hub, so budget scales with N.
         wait_convergence(nodes, n - 1, only_direct=False, wait=max(120, n))
-        t_ready = time.time()
+        t_ready = time.monotonic()
         print(f"Topology converged in {t_ready - t_start:.1f}s; starting...")
 
         nodes[0].set_start_learning(rounds=args.rounds, epochs=args.epochs)
         wait_to_finish(nodes, timeout=3600)
-        t_done = time.time()
+        t_done = time.monotonic()
 
         # Model agreement: "all nodes finished" alone can hide nodes
         # that timed out of the aggregation wait and ended the round on

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 
 from tpfl.concurrency import make_lock
 from tpfl.learning.model import TpflModel
+from tpfl.management import tracing
 from tpfl.management.logger import logger
 from tpfl.settings import Settings
 
@@ -274,7 +275,23 @@ class Aggregator(ABC):
                 )
                 if self._covered_meets_quorum(covered):
                     self._finish_aggregation_event.set()
-            return self._finish_aggregation_event.is_set()
+            closed = self._finish_aggregation_event.is_set()
+        if removable:
+            # Quorum degradation is a flight-recorder moment: record it
+            # (and flush the ring for the post-mortem) OUTSIDE _lock —
+            # telemetry must never extend a protocol critical section.
+            logger.metrics.counter(
+                "tpfl_agg_quorum_degraded_total",
+                labels={"node": self.node_name},
+            )
+            tracing.event(
+                "quorum_degraded", self.node_name,
+                removed=",".join(sorted(removable)),
+            )
+            from tpfl.management.telemetry import flight
+
+            flight.dump(self.node_name, "quorum_degraded")
+        return closed
 
     def clear(self) -> None:
         """End a round (reference RoundFinishedStage calls this)."""
@@ -372,9 +389,15 @@ class Aggregator(ABC):
                 and not self._stream_dead
             ):
                 try:
+                    t_fold = time.monotonic()
                     if self._stream is None:
                         self._stream = self.acc_init(model)
                     self._stream = self.accumulate(self._stream, model)
+                    logger.metrics.observe(
+                        "tpfl_agg_fold_seconds",
+                        time.monotonic() - t_fold,
+                        labels={"node": self.node_name},
+                    )
                 except Exception as e:
                     logger.debug(
                         self.node_name,
@@ -433,12 +456,31 @@ class Aggregator(ABC):
             raise NoModelsToAggregateError(
                 f"({self.node_name}) No models to aggregate"
             )
-        if stream is not None and stream.offered == len(models) and stream.count:
-            # Every held model went through the eager fold: the round's
-            # reduce already happened on-device as partials arrived —
-            # close is a single finalize.
-            return self.finalize(stream)
-        return self.aggregate(models)
+        t_close = time.monotonic()
+        try:
+            with tracing.maybe_span(
+                "aggregate", self.node_name, held=len(models),
+                eager=bool(stream is not None),
+            ):
+                if (
+                    stream is not None
+                    and stream.offered == len(models)
+                    and stream.count
+                ):
+                    # Every held model went through the eager fold: the
+                    # round's reduce already happened on-device as
+                    # partials arrived — close is a single finalize.
+                    return self.finalize(stream)
+                return self.aggregate(models)
+        finally:
+            # Round-close aggregation wall time, eager or batch — the
+            # aggregator timing the registry always carries even when
+            # span tracing is off.
+            logger.metrics.observe(
+                "tpfl_agg_aggregate_seconds",
+                time.monotonic() - t_close,
+                labels={"node": self.node_name},
+            )
 
     def get_model(self, except_nodes: list[str] | None = None) -> TpflModel | None:
         """Partial aggregate of held models excluding contributions from

@@ -401,17 +401,22 @@ def encode_model_payload(
     delta_base: Optional[tuple[int, bytes, Any]] = None,
     topk_frac: float = 0.05,
     level: int = 1,
+    trace_id: Optional[str] = None,
 ) -> bytes:
     """v2 wire envelope. ``delta_base`` is ``(round, fingerprint,
     base_params)`` — when given, the body carries ``params - base`` and
     the envelope names the base so the receiver can refuse a base it
-    does not hold (DeltaBaseMismatchError -> sender falls back dense)."""
+    does not hold (DeltaBaseMismatchError -> sender falls back dense).
+    ``trace_id``: hop-tracing id carried as an outer-map ``tid`` key
+    (decoders ignore unknown keys; tracing.payload_trace_id peeks it)."""
     bits = resolve_codec(codec)
     env: dict[str, Any] = {
         "contributors": list(contributors),
         "num_samples": int(num_samples),
         "info": serialization._encode_obj(additional_info),
     }
+    if trace_id:
+        env["tid"] = str(trace_id)
     tree = params
     if delta_base is not None:
         base_round, base_fp, base_params = delta_base

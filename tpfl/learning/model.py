@@ -160,6 +160,7 @@ class TpflModel:
         params: Optional[Pytree] = None,
         codec: "str | int | None" = None,
         delta_base: Optional[tuple] = None,
+        trace_id: Optional[str] = None,
     ) -> bytes:
         """Wire-encode the parameters through the codec registry.
 
@@ -170,7 +171,14 @@ class TpflModel:
 
         ``delta_base``: ``(round, fingerprint, base_params)`` — encode a
         residual against an acknowledged base instead of the full
-        weights (GossipModelStage's delta-gossip path)."""
+        weights (GossipModelStage's delta-gossip path).
+
+        ``trace_id``: hop-tracing id (tpfl.management.tracing) embedded
+        in whichever envelope is emitted — minted by the transport's
+        ``model_payload`` seam when ``Settings.TELEMETRY_ENABLED``;
+        None (bare encodes: beacon hashes, delta-base round-trips,
+        checkpoints) leaves the envelope untagged and byte-identical
+        to pre-telemetry output."""
         from tpfl.settings import Settings
 
         params = params if params is not None else self._params
@@ -187,6 +195,7 @@ class TpflModel:
                 delta_base=delta_base,
                 topk_frac=Settings.WIRE_TOPK_FRAC,
                 level=Settings.WIRE_ENTROPY_LEVEL,
+                trace_id=trace_id,
             )
         if Settings.WIRE_DTYPE:
             # Wire compression: downcast float leaves (f32/f64) only;
@@ -210,24 +219,29 @@ class TpflModel:
                 self._num_samples,
                 self.additional_info,
                 pool=self.buffer_pool,
+                trace_id=trace_id,
             )
         return serialization.encode_model_payload(
             params,
             self._contributors,
             self._num_samples,
             self.additional_info,
+            trace_id=trace_id,
         )
 
-    def as_ref(self) -> "serialization.InprocModelRef":
+    def as_ref(self, trace: str = "") -> "serialization.InprocModelRef":
         """By-reference payload for co-located nodes
         (``Settings.INPROC_ZERO_COPY``): no encode, no decode, no bytes
         — the parameter pytree is handed across with frozen leaves and
-        copied metadata. Only the in-memory transport may carry one."""
+        copied metadata. Only the in-memory transport may carry one.
+        ``trace``: hop-tracing id (the ref analog of the envelopes'
+        ``tid`` key)."""
         return serialization.InprocModelRef(
             self._params,
             self._contributors,
             self._num_samples,
             self.additional_info,
+            trace=trace,
         )
 
     def decode_parameters(self, data: bytes) -> Pytree:

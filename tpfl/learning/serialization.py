@@ -198,10 +198,15 @@ def encode_model_payload(
     contributors: list[str],
     num_samples: int,
     additional_info: dict[str, Any],
+    trace_id: Optional[str] = None,
 ) -> bytes:
     """v1 wire envelope (legacy dense msgpack map — what old peers
     decode). New code paths emit v3 via :func:`encode_model_payload_v3`
-    (``Settings.WIRE_FORMAT``); this stays the interop encoder."""
+    (``Settings.WIRE_FORMAT``); this stays the interop encoder.
+    ``trace_id``: optional 16-byte hop-tracing id
+    (tpfl.management.tracing) carried as an extra ``tid`` key —
+    decoders ignore unknown map keys, so pre-telemetry peers keep
+    decoding."""
     env = {
         "v": WIRE_VERSION,
         "params": _encode_obj(params),
@@ -209,6 +214,8 @@ def encode_model_payload(
         "num_samples": int(num_samples),
         "info": _encode_obj(additional_info),
     }
+    if trace_id:
+        env["tid"] = str(trace_id)
     return msgpack.packb(env, use_bin_type=True)
 
 
@@ -295,6 +302,7 @@ def encode_model_payload_v3(
     num_samples: int,
     additional_info: dict[str, Any],
     pool: Any = None,
+    trace_id: Optional[str] = None,
 ) -> bytes:
     """v3 wire envelope: msgpack header (dtype/shape/offset table) +
     ONE contiguous payload. Assembly is a single ``bytes.join`` over
@@ -303,7 +311,11 @@ def encode_model_payload_v3(
     msgpack buffer growth, no staging copy). ``pool``: a
     :class:`~tpfl.learning.bufferpool.BufferPool` backing the
     contiguation scratch for strided leaves (default: the process
-    pool; plain contiguous leaves never touch it)."""
+    pool; plain contiguous leaves never touch it). ``trace_id``: hop-
+    tracing id embedded as a header ``tid`` key — the header is small,
+    so receivers (and the transport's Message tagging) can peek it
+    without touching the payload region; v3 decoders ignore unknown
+    header keys."""
     metas: list = []
     offset = [0]
     with _Scratch(pool) as scratch:
@@ -314,6 +326,8 @@ def encode_model_payload_v3(
             "info": _v3_plan(additional_info, metas, offset, scratch),
             "psz": offset[0],
         }
+        if trace_id:
+            header_tree["tid"] = str(trace_id)
         header = msgpack.packb(header_tree, use_bin_type=True)
         parts: list = [_V3_PREFIX, struct.pack("<I", len(header)), header]
         end = 0
@@ -412,7 +426,7 @@ class InprocModelRef:
     a process boundary — the gRPC transport raises if one reaches its
     wire framing."""
 
-    __slots__ = ("params", "contributors", "num_samples", "info")
+    __slots__ = ("params", "contributors", "num_samples", "info", "trace")
 
     def __init__(
         self,
@@ -420,6 +434,7 @@ class InprocModelRef:
         contributors: list[str],
         num_samples: int,
         info: dict[str, Any],
+        trace: str = "",
     ) -> None:
         self.params = freeze_tree(params)
         # Metadata is COPIED, not shared: the receiver updates its own
@@ -428,6 +443,10 @@ class InprocModelRef:
         self.contributors = list(contributors)
         self.num_samples = int(num_samples)
         self.info = {k: _freeze_leaf(v) for k, v in dict(info).items()}
+        # Hop-tracing id (tpfl.management.tracing): the by-reference
+        # analog of the byte envelopes' ``tid`` key — a ref hop is
+        # still a hop in the traceview timeline.
+        self.trace = str(trace)
 
     def __len__(self) -> int:
         # Payload accounting sites treat refs as size-0: no bytes moved.

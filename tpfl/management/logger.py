@@ -22,6 +22,8 @@ import os
 import queue
 from typing import Any, Optional
 
+from tpfl.concurrency import make_lock
+from tpfl.management import telemetry
 from tpfl.management.metric_storage import (
     GlobalMetricStorage,
     LocalMetricStorage,
@@ -96,8 +98,21 @@ class TpflLogger:
         # (communication.resilience); surfaces sends_failed /
         # breaker_state that previously vanished at debug level.
         self.transport_metrics = TransportMetricStorage()
+        # The process metrics registry (tpfl.management.telemetry):
+        # counters/gauges/histograms behind ONE facade — transport
+        # health, buffer-pool stats, codec bytes, aggregator timings,
+        # system gauges all land here and export as Prometheus text /
+        # JSON (web_services.MetricsHTTPServer).
+        self.metrics = telemetry.metrics
         # addr -> {"simulation": bool, "experiment": Experiment | None, "round": int | None}
+        # guarded-by: _nodes_lock
         self._nodes: dict[str, dict[str, Any]] = {}
+        """Registered-node registry. Written by register/unregister
+        (main thread, test teardowns) and experiment lifecycle hooks
+        (learning threads), read by metric routing on every gossiped
+        metric (gRPC handler threads) — all access under
+        ``_nodes_lock``, and ``get_nodes`` returns a snapshot copy."""
+        self._nodes_lock = make_lock("TpflLogger._nodes_lock")
 
     # --- levels ---
 
@@ -139,7 +154,8 @@ class TpflLogger:
     ) -> tuple[str, Optional[int]]:
         """(exp_name, round) for a node, filling round from its running
         experiment when not given. Shared by base and web decorators."""
-        info = self._nodes.get(addr)
+        with self._nodes_lock:
+            info = self._nodes.get(addr)
         exp_name = "unknown-exp"
         if info is not None and info.get("experiment") is not None:
             exp = info["experiment"]
@@ -165,44 +181,64 @@ class TpflLogger:
             self.local_metrics.add_log(exp_name, round, metric, addr, value, step)
 
     def log_system_metric(self, node: str, metric: str, value: float) -> None:
-        """Resource metrics hook (reference logger.py:443-454). Extended by
-        the web decorator; no-op in the base."""
+        """Resource metrics hook (reference logger.py:443-454). The
+        base routes the reading into the process registry
+        (``self.metrics``) as a gauge; the web decorator additionally
+        pushes it to the dashboard."""
+        self.metrics.gauge(f"tpfl_system_{metric}", value, labels={"node": node})
 
     def get_local_logs(self):
+        """Snapshot copy (taken under the storage lock) — mutating the
+        returned structure cannot corrupt the live store, and handler
+        threads keep logging while the caller iterates."""
         return self.local_metrics.get_all_logs()
 
     def get_global_logs(self):
+        """Snapshot copy — same contract as :meth:`get_local_logs`."""
         return self.global_metrics.get_all_logs()
 
     def get_transport_logs(self):
         """node -> neighbor -> send-health counters (sends_ok,
-        sends_failed, retries, breaker_state, breaker_opens)."""
+        sends_failed, retries, breaker_state, breaker_opens). Snapshot
+        copy taken under the storage lock — the breaker keeps counting
+        while the caller reads."""
         return self.transport_metrics.get_all_logs()
 
     # --- node registry (reference logger.py:342-372) ---
 
     def register_node(self, node: str, simulation: bool = False) -> None:
-        if node in self._nodes:
-            raise Exception(f"Node {node} already registered.")
-        self._nodes[node] = {"simulation": simulation, "experiment": None}
+        with self._nodes_lock:
+            if node in self._nodes:
+                raise Exception(f"Node {node} already registered.")
+            self._nodes[node] = {"simulation": simulation, "experiment": None}
 
     def unregister_node(self, node: str) -> None:
-        self._nodes.pop(node, None)
+        with self._nodes_lock:
+            self._nodes.pop(node, None)
 
     def get_nodes(self) -> dict[str, dict[str, Any]]:
-        return self._nodes
+        """Snapshot copy of the registry — safe to iterate while
+        register/unregister run on other threads."""
+        with self._nodes_lock:
+            return {k: dict(v) for k, v in self._nodes.items()}
 
     # --- experiment lifecycle (reference logger.py:378-421) ---
 
     def experiment_started(self, node: str, experiment: Any) -> None:
-        self._nodes.setdefault(node, {"simulation": False})["experiment"] = experiment
+        with self._nodes_lock:
+            self._nodes.setdefault(node, {"simulation": False})[
+                "experiment"
+            ] = experiment
         self.info(node, f"Experiment '{getattr(experiment, 'exp_name', '?')}' started")
 
     def experiment_finished(self, node: str) -> None:
         self.info(node, "Experiment finished")
 
     def round_started(self, node: str, experiment: Any) -> None:
-        self._nodes.setdefault(node, {"simulation": False})["experiment"] = experiment
+        with self._nodes_lock:
+            self._nodes.setdefault(node, {"simulation": False})[
+                "experiment"
+            ] = experiment
         self.debug(node, f"Round {getattr(experiment, 'round', '?')} started")
 
     def round_finished(self, node: str) -> None:
@@ -315,6 +351,9 @@ class WebLogger(LoggerDecorator):
     def __init__(self, inner) -> None:
         super().__init__(inner)
         self._web: Any = None
+        # unguarded: register/unregister run on the node-lifecycle
+        # thread (start/stop call sites); monitors are per-node and
+        # never touched concurrently for the same key.
         self._monitors: dict[str, Any] = {}
 
     def connect_web(self, url: str, key: str) -> None:

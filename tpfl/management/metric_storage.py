@@ -16,6 +16,14 @@ Parity with reference ``p2pfl/management/metric_storage.py``:
 
 Thread-safe: gRPC handler threads, the learning thread, and the monitor
 thread all log concurrently.
+
+Bounded: every per-series point list is capped at
+``Settings.METRIC_MAX_POINTS`` (oldest evicted first) — a long-running
+node's per-step training series must not be the one unbounded
+allocation in the management layer. Transport counters are mirrored
+into the process metrics registry (``logger.metrics``,
+:mod:`tpfl.management.telemetry`) so they export as Prometheus series
+alongside everything else.
 """
 
 from __future__ import annotations
@@ -23,6 +31,17 @@ from __future__ import annotations
 import copy
 
 from tpfl.concurrency import make_lock
+from tpfl.management import telemetry
+from tpfl.settings import Settings
+
+
+def _capped_append(series: list, point: tuple) -> None:
+    """Append honoring Settings.METRIC_MAX_POINTS (drop-oldest).
+    Caller holds the owning store's lock."""
+    series.append(point)
+    cap = max(1, int(Settings.METRIC_MAX_POINTS))
+    if len(series) > cap:
+        del series[: len(series) - cap]
 
 LocalMetrics = dict[str, dict[int, dict[str, dict[str, list[tuple[int, float]]]]]]
 GlobalMetrics = dict[str, dict[str, dict[str, list[tuple[int, float]]]]]
@@ -49,7 +68,7 @@ class LocalMetricStorage:
             exp = self._store.setdefault(exp_name, {})
             rnd = exp.setdefault(round, {})
             nd = rnd.setdefault(node, {})
-            nd.setdefault(metric, []).append((step, float(val)))
+            _capped_append(nd.setdefault(metric, []), (step, float(val)))
 
     def get_all_logs(self) -> LocalMetrics:
         with self._lock:
@@ -85,7 +104,7 @@ class GlobalMetricStorage:
             series = nd.setdefault(metric, [])
             # Dedup: only one value per (metric, round) — metric_storage.py:208-210
             if round not in [r for r, _ in series]:
-                series.append((round, float(val)))
+                _capped_append(series, (round, float(val)))
 
     def get_all_logs(self) -> GlobalMetrics:
         with self._lock:
@@ -137,6 +156,18 @@ class TransportMetricStorage:
             e = self._entry(node, neighbor)
             e["sends_ok" if ok else "sends_failed"] += 1  # type: ignore[operator]
             e["retries"] += max(0, attempts - 1)  # type: ignore[operator]
+        # Mirror into the process registry (outside the store lock —
+        # the registry hot path is lock-free, keep it edge-free too).
+        telemetry.metrics.counter(
+            "tpfl_transport_sends_total",
+            labels={"node": node, "ok": "1" if ok else "0"},
+        )
+        if attempts > 1:
+            telemetry.metrics.counter(
+                "tpfl_transport_retries_total",
+                float(attempts - 1),
+                labels={"node": node},
+            )
 
     def record_breaker(self, node: str, neighbor: str, state: str) -> None:
         with self._lock:
@@ -144,6 +175,15 @@ class TransportMetricStorage:
             e["breaker_state"] = state
             if state == "open":
                 e["breaker_opens"] += 1  # type: ignore[operator]
+        if state == "open":
+            telemetry.metrics.counter(
+                "tpfl_breaker_opens_total", labels={"node": node}
+            )
+        telemetry.metrics.gauge(
+            "tpfl_breaker_open",
+            1.0 if state == "open" else 0.0,
+            labels={"node": node, "neighbor": neighbor},
+        )
 
     def get_all_logs(self) -> TransportMetrics:
         with self._lock:

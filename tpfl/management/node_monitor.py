@@ -2,33 +2,52 @@
 
 Parity with reference ``p2pfl/management/node_monitor.py:31-82``: samples
 CPU%, RAM%, and network in/out every ``Settings.RESOURCE_MONITOR_PERIOD``
-seconds and pushes each reading through a callback
-(``callback(node, metric, value)``). Also samples TPU/accelerator memory
-when JAX devices expose ``memory_stats`` — the TPU-native addition.
+seconds. Also samples TPU/accelerator memory when JAX devices expose
+``memory_stats`` — the TPU-native addition.
+
+Readings route through the process metrics registry
+(:mod:`tpfl.management.telemetry`, ``tpfl_system_*`` gauges labeled by
+node) — the single facade everything exports from — and additionally
+through an optional callback (``callback(node, metric, value)``) for
+the web-dashboard push path.
+
+Thread/lock hygiene: the thread carries a real ``name=`` and its lock
+comes from ``tpfl.concurrency.make_lock``, so the thread-lifecycle and
+guarded-by lints (and ``Settings.LOCK_TRACING``) cover it like every
+other protocol thread.
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from typing import Callable
+from typing import Callable, Optional
 
 import psutil
 
+from tpfl.concurrency import make_lock
+from tpfl.management import telemetry
 from tpfl.settings import Settings
 
 
 class NodeMonitor(threading.Thread):
     def __init__(
-        self, node_addr: str, report_fn: Callable[[str, str, float], None]
+        self,
+        node_addr: str,
+        report_fn: Optional[Callable[[str, str, float], None]] = None,
     ) -> None:
         super().__init__(daemon=True, name=f"node-monitor-{node_addr}")
         self._node = node_addr
         self._report = report_fn
         self._running = threading.Event()
         self._running.set()
+        self._lock = make_lock("NodeMonitor._lock")
         net = psutil.net_io_counters()
+        # guarded-by: _lock
         self._last_net = (net.bytes_recv, net.bytes_sent, time.monotonic())
+        """(bytes_recv, bytes_sent, stamp) of the previous sample.
+        Written by the monitor thread, readable by tests/diagnostics —
+        the lock keeps the 3-tuple swap atomic to observers."""
 
     def stop(self) -> None:
         self._running.clear()
@@ -41,16 +60,24 @@ class NodeMonitor(threading.Thread):
                 pass
             time.sleep(Settings.RESOURCE_MONITOR_PERIOD)
 
+    def _emit(self, metric: str, value: float) -> None:
+        telemetry.metrics.gauge(
+            f"tpfl_system_{metric}", value, labels={"node": self._node}
+        )
+        if self._report is not None:
+            self._report(self._node, metric, value)
+
     def _sample(self) -> None:
-        self._report(self._node, "cpu_percent", psutil.cpu_percent())
-        self._report(self._node, "ram_percent", psutil.virtual_memory().percent)
+        self._emit("cpu_percent", psutil.cpu_percent())
+        self._emit("ram_percent", psutil.virtual_memory().percent)
         net = psutil.net_io_counters()
         now = time.monotonic()
-        last_recv, last_sent, last_t = self._last_net
+        with self._lock:
+            last_recv, last_sent, last_t = self._last_net
+            self._last_net = (net.bytes_recv, net.bytes_sent, now)
         dt = max(now - last_t, 1e-9)
-        self._report(self._node, "net_in_bytes_per_s", (net.bytes_recv - last_recv) / dt)
-        self._report(self._node, "net_out_bytes_per_s", (net.bytes_sent - last_sent) / dt)
-        self._last_net = (net.bytes_recv, net.bytes_sent, now)
+        self._emit("net_in_bytes_per_s", (net.bytes_recv - last_recv) / dt)
+        self._emit("net_out_bytes_per_s", (net.bytes_sent - last_sent) / dt)
         self._sample_tpu()
 
     def _sample_tpu(self) -> None:
@@ -64,8 +91,8 @@ class NodeMonitor(threading.Thread):
                     continue
                 s = stats()
                 if s and "bytes_in_use" in s:
-                    self._report(
-                        self._node, f"hbm_bytes_in_use_dev{d.id}", float(s["bytes_in_use"])
+                    self._emit(
+                        f"hbm_bytes_in_use_dev{d.id}", float(s["bytes_in_use"])
                     )
         except Exception:
             pass

@@ -1,18 +1,28 @@
-"""REST client for the web dashboard.
+"""REST client for the web dashboard + Prometheus metrics endpoint.
 
 Parity with reference ``p2pfl/management/p2pfl_web_services.py:58-136``:
 node registration, log push, local/global/system metric push, x-api-key
 auth. Uses stdlib urllib (the reference uses ``requests``) so there is no
 extra dependency; failures are swallowed after logging — observability
 must never take a node down.
+
+:class:`MetricsHTTPServer` is the pull-side counterpart: a tiny stdlib
+HTTP server exposing the process metrics registry
+(:mod:`tpfl.management.telemetry`) as Prometheus text at ``/metrics``
+and as JSON at ``/metrics.json`` — point a scraper at any simulation
+host and every node's counters/gauges/histograms are one GET away.
 """
 
 from __future__ import annotations
 
 import json
+import threading
 import urllib.error
 import urllib.request
-from typing import Any
+from http.server import BaseHTTPRequestHandler, HTTPServer
+from typing import Any, Optional
+
+from tpfl.management import telemetry
 
 
 class TpflWebServices:
@@ -86,3 +96,65 @@ class TpflWebServices:
             "/node-metric/system",
             {"address": node, "metric": metric, "value": value, "time": time},
         )
+
+
+class MetricsHTTPServer:
+    """Prometheus/JSON exposition of the process metrics registry.
+
+    ``start()`` binds (port 0 = ephemeral; the bound port is returned
+    and kept on ``self.port``) and serves on a named daemon thread;
+    ``stop()`` shuts it down. One per process is the expected shape —
+    the registry is process-wide, so a single endpoint covers every
+    simulated node."""
+
+    def __init__(
+        self, port: int = 0, registry: "telemetry.MetricsRegistry | None" = None
+    ) -> None:
+        self._registry = registry if registry is not None else telemetry.metrics
+        self._port = port
+        self._httpd: Optional[HTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self.port: int = 0
+
+    def start(self) -> int:
+        registry = self._registry
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 (stdlib API name)
+                if self.path.startswith("/metrics.json"):
+                    body = registry.dump_json().encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/metrics"):
+                    body = registry.render_prometheus().encode()
+                    ctype = "text/plain; version=0.0.4"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args: Any) -> None:  # quiet
+                pass
+
+        self._httpd = HTTPServer(("127.0.0.1", self._port), Handler)
+        self.port = self._httpd.server_port
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            daemon=True,
+            name=f"tpfl-metrics-http-{self.port}",
+        )
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=3)
+            self._thread = None

@@ -112,6 +112,29 @@ class Node:
         )
         self.learner.get_model().buffer_pool = self.buffer_pool
 
+        # Buffer-pool stats publish through the metrics registry as a
+        # pull-style collector (invoked at scrape/dump time, outside
+        # the pool's hot path); unregistered in stop().
+        pool, addr = self.buffer_pool, self.addr
+
+        def _pool_collector(registry: Any) -> None:
+            labels = {"node": addr}
+            registry.gauge("tpfl_bufferpool_hits", float(pool.hits), labels=labels)
+            registry.gauge(
+                "tpfl_bufferpool_misses", float(pool.misses), labels=labels
+            )
+            registry.gauge(
+                "tpfl_bufferpool_pooled_bytes", float(pool.pooled_bytes),
+                labels=labels,
+            )
+            registry.gauge(
+                "tpfl_bufferpool_outstanding", float(pool.outstanding),
+                labels=labels,
+            )
+
+        self._pool_collector = _pool_collector
+        logger.metrics.register_collector(_pool_collector)
+
         # Experiment parameters (set by set_start_learning / command)
         self.rounds: int = 0
         self.epochs: int = 1
@@ -153,6 +176,17 @@ class Node:
         logger.unregister_node(self.addr)
         self._running = False
         logger.info(self.addr, "Node stopped")
+        logger.metrics.unregister_collector(self._pool_collector)
+        if Settings.TELEMETRY_ENABLED:
+            # Flush this node's flight ring on the way out: the last N
+            # spans/events are the post-mortem for whatever ended the
+            # node (a JSON dump lands in Settings.TELEMETRY_DUMP_DIR
+            # when set — the traceview input).
+            from tpfl.management.telemetry import flight
+
+            path = flight.dump(self.addr, "stop")
+            if path is not None:
+                logger.info(self.addr, f"Flight recorder dumped to {path}")
         if Settings.LOCK_TRACING:
             # Traced runs (chaos/e2e) check the RUNTIME lock-acquisition
             # graph on the way out: a cycle is a latent deadlock, and

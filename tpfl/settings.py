@@ -279,6 +279,49 @@ class Settings:
     # --- observability ---
     RESOURCE_MONITOR_PERIOD: float = 1.0
 
+    TELEMETRY_ENABLED: bool = False
+    """Master gate for hop-level distributed tracing
+    (tpfl.management.tracing): when on, every model-payload encode
+    mints a 16-byte trace id that rides the wire envelope (v3 header
+    ``tid`` extension; v1/v2 peers still decode) and the in-proc
+    ``InprocModelRef``, and every gossip hop, retry, breaker trip,
+    decode, and aggregation fold becomes a span in the per-node flight
+    recorder — reconstructable across nodes into a round timeline by
+    ``tools/traceview.py``. Off by default: the metrics REGISTRY
+    (``logger.metrics``) always records (cheap per-thread dict
+    updates), but span minting/recording is gated here — measured <5%
+    rounds/sec overhead when on (bench.py telemetry tier), zero when
+    off. Read at use time, so it can be toggled between experiments."""
+
+    TELEMETRY_RING: int = 512
+    """Flight-recorder capacity: the last N spans/events retained PER
+    NODE (tpfl.management.telemetry.FlightRecorder). The ring is what
+    ``Node.stop()`` and the chaos harness dump on crash or quorum
+    degradation — size it to cover at least one full round of spans
+    for post-mortems (a 4-node round is a few hundred spans)."""
+
+    TELEMETRY_MAX_LABELSETS: int = 64
+    """Label-cardinality cap per metric in the registry
+    (tpfl.management.telemetry.MetricsRegistry): label sets beyond the
+    cap collapse into a reserved ``{"overflow": "true"}`` series
+    instead of growing without bound — a per-peer label on a
+    1000-node federation must not turn the registry into the leak it
+    exists to observe."""
+
+    TELEMETRY_DUMP_DIR: str = ""
+    """Directory for flight-recorder crash dumps (JSON, one file per
+    (node, reason)). Empty (default) disables file dumps — the ring
+    still records and ``logger.metrics``/``FlightRecorder.snapshot``
+    stay queryable in-process. Set by the chaos harness / bench so
+    every injected crash and quorum degradation is post-mortem-able."""
+
+    METRIC_MAX_POINTS: int = 4096
+    """Per-series point cap in the local/global metric stores
+    (tpfl.management.metric_storage): a series keeps the most recent N
+    (step, value) / (round, value) points, evicting oldest-first. An
+    unbounded per-step series on a long-running node was the only
+    unbounded memory left in the management layer."""
+
     GOSSIP_METRICS: bool = True
     """Broadcast eval metrics to the federation after each round
     (reference MetricsCommand behavior). At N nodes each broadcast
@@ -398,6 +441,14 @@ class Settings:
         cls.BREAKER_THRESHOLD = 3
         cls.BREAKER_PROBE_PERIOD = 1.0
         cls.ROUND_QUORUM = 1.0
+        # Telemetry off in tests by default: tracing tests toggle
+        # per-case; the registry records regardless (it is cheap and
+        # deterministic).
+        cls.TELEMETRY_ENABLED = False
+        cls.TELEMETRY_RING = 512
+        cls.TELEMETRY_MAX_LABELSETS = 64
+        cls.TELEMETRY_DUMP_DIR = ""
+        cls.METRIC_MAX_POINTS = 4096
 
     @classmethod
     def set_standalone_settings(cls) -> None:
@@ -449,6 +500,13 @@ class Settings:
         cls.BREAKER_THRESHOLD = 3
         cls.BREAKER_PROBE_PERIOD = 15.0
         cls.ROUND_QUORUM = 1.0
+        # Tracing is an opt-in diagnostic (enable for a run you intend
+        # to traceview); the ring and caps stay at class defaults.
+        cls.TELEMETRY_ENABLED = False
+        cls.TELEMETRY_RING = 512
+        cls.TELEMETRY_MAX_LABELSETS = 64
+        cls.TELEMETRY_DUMP_DIR = ""
+        cls.METRIC_MAX_POINTS = 4096
 
     @classmethod
     def set_scale_settings(cls) -> None:
@@ -537,6 +595,16 @@ class Settings:
         cls.BREAKER_THRESHOLD = 3
         cls.BREAKER_PROBE_PERIOD = 30.0
         cls.ROUND_QUORUM = 1.0
+        # At 1000 in-process nodes every span append shares the GIL
+        # with the federation itself: tracing stays off (the <5%
+        # measured overhead is per-node, not per-host), the ring
+        # shrinks (1000 rings x 512 spans is real memory), and the
+        # label cap guards against per-peer label explosions.
+        cls.TELEMETRY_ENABLED = False
+        cls.TELEMETRY_RING = 128
+        cls.TELEMETRY_MAX_LABELSETS = 64
+        cls.TELEMETRY_DUMP_DIR = ""
+        cls.METRIC_MAX_POINTS = 4096
 
     @classmethod
     def snapshot(cls) -> dict[str, Any]:

@@ -28,6 +28,7 @@ from tpfl.communication.commands import (
 )
 from tpfl.experiment import Experiment
 from tpfl.learning.aggregators.aggregator import NoModelsToAggregateError
+from tpfl.management import tracing
 from tpfl.management.logger import logger
 from tpfl.settings import Settings
 from tpfl.stages.stage import Stage, check_early_stop
@@ -307,7 +308,11 @@ class TrainStage(Stage):
         # aggregated full model (contributors = whole train set, no
         # per-client callback info) mid-fit, which must never enter our
         # own aggregator.
-        fitted = node.learner.fit()
+        with tracing.maybe_span(
+            "train_fit", node.addr,
+            round=st.round if st.round is not None else -1,
+        ):
+            fitted = node.learner.fit()
         if check_early_stop(node):
             node.aggregator.clear()
             return None
@@ -705,6 +710,10 @@ class RoundFinishedStage(Stage):
         # (round-tagged entries are filtered at tally time).
         st.votes_ready_event.clear()
         st.increase_round()
+        tracing.event(
+            "round_finished", node.addr,
+            round=(st.round - 1) if st.round is not None else -1,
+        )
         logger.round_finished(node.addr)
         logger.info(
             node.addr,

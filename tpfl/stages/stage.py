@@ -16,6 +16,7 @@ from __future__ import annotations
 from abc import ABC, abstractmethod
 from typing import TYPE_CHECKING, Optional, Type
 
+from tpfl.management import tracing
 from tpfl.management.logger import logger
 
 if TYPE_CHECKING:
@@ -57,7 +58,15 @@ class StageWorkflow:
             while stage is not None:
                 self.history.append(stage.name)
                 logger.debug(node.addr, f"Stage: {stage.name}")
-                stage = stage.execute(node)
+                # Round spans: every stage execution is a span in the
+                # node's flight ring, tagged with the round it served —
+                # the timeline's per-node backbone that the payload hop
+                # spans hang between.
+                with tracing.maybe_span(
+                    f"stage:{stage.name}", node.addr,
+                    round=node.state.round if node.state.round is not None else -1,
+                ):
+                    stage = stage.execute(node)
         except EarlyStopException:
             logger.info(node.addr, "Workflow stopped early")
         finally:

@@ -24,8 +24,8 @@ def wait_convergence(
 ) -> None:
     """Poll until every node sees ``n_neighbors`` peers (reference
     utils.py:60-84)."""
-    deadline = time.time() + wait
-    while time.time() < deadline:
+    deadline = time.monotonic() + wait
+    while time.monotonic() < deadline:
         if all(
             len(n.get_neighbors(only_direct=only_direct)) == n_neighbors
             for n in nodes
@@ -47,8 +47,8 @@ def full_connection(node, peers: Sequence) -> None:
 def wait_to_finish(nodes: Sequence, timeout: float = 3600.0) -> None:
     """Block until every node's workflow finished (reference
     utils.py:100-116)."""
-    deadline = time.time() + timeout
-    while time.time() < deadline:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
         if all(n.learning_finished() for n in nodes):
             return
         time.sleep(0.1)
