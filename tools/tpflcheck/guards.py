@@ -63,6 +63,7 @@ GUARDED_MODULES = (
     "tpfl/management/metric_storage.py",
     "tpfl/management/logger.py",
     "tpfl/management/node_monitor.py",
+    "tpfl/management/profiling.py",
     "tpfl/management/telemetry.py",
     "tpfl/management/tracing.py",
     "tpfl/learning/aggregators/aggregator.py",

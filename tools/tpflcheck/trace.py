@@ -1,16 +1,20 @@
 """Timing/logging-path lint: spans and metrics are the only sanctioned
 timing path.
 
-Two invariants over ``tpfl/`` (the management layer is exempt — it IS
-the telemetry implementation and owns the wall-clock anchor):
+Two invariants over ``tpfl/``, ``tools/`` and the root bench/dryrun
+scripts (the management layer is exempt — it IS the telemetry/
+profiling implementation and owns the wall-clock anchor; ``tools/perf``
+is exempt — superseded lab-notebook scratch scripts, see their
+README):
 
-1. **No ``time.time()``** — every duration, deadline, and stamp in the
-   protocol must come from ``time.monotonic()`` (NTP-step immunity —
-   the aggregator stall clock and round deadlines moved first; this
-   lint keeps the rest from regressing) or flow through the tracing
-   spans in :mod:`tpfl.management.tracing`, which timestamp
-   monotonically and carry the process wall anchor for cross-process
-   merges.
+1. **No ``time.time()``** — every duration, deadline, and stamp must
+   come from ``time.monotonic()`` / ``time.perf_counter()`` (NTP-step
+   immunity — the aggregator stall clock and round deadlines moved
+   first; this lint keeps the rest, INCLUDING new timing code in the
+   bench and the profiling subsystem's call sites, from regressing) or
+   flow through the spans in :mod:`tpfl.management.tracing` /
+   :mod:`tpfl.management.profiling`, which timestamp monotonically and
+   carry the process wall anchor for cross-process merges.
 
 2. **No raw ``logging`` calls** — ``logging.getLogger``/``logging.info``
    etc. bypass the framework logger's routing (node tagging, async
@@ -39,12 +43,31 @@ _LOGGING_CALLS = {
 }
 
 
+#: Lab-notebook scratch scripts (tools/perf/README.md): frozen
+#: measurement receipts, not maintained code — outside the lint.
+EXEMPT_PREFIXES = ("tools/perf/",)
+
+#: Root-level scripts with timing code the lint also covers (new
+#: timing in the bench must ride monotonic()/perf_counter() or the
+#: profiling API, same as the package).
+ROOT_SCRIPTS = ("bench.py", "__graft_entry__.py")
+
+
+def _lint_files(root: "pathlib.Path") -> "list[pathlib.Path]":
+    files = list(py_files(root))
+    files += py_files(root, "tools")
+    files += [root / s for s in ROOT_SCRIPTS if (root / s).exists()]
+    return files
+
+
 def check_trace(repo: "pathlib.Path | None" = None) -> list[Violation]:
     root = repo_root(repo)
     out: list[Violation] = []
-    for path in py_files(root):
+    for path in _lint_files(root):
         r = rel(root, path)
         if r.startswith(ALLOWED_PREFIX):
+            continue
+        if any(r.startswith(p) for p in EXEMPT_PREFIXES):
             continue
         tree = ast.parse(path.read_text(encoding="utf-8"))
         for node in ast.walk(tree):

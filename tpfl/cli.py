@@ -9,6 +9,7 @@ there (``cli.py:71-95``); here they are omitted entirely.
 from __future__ import annotations
 
 import importlib
+import os
 import pkgutil
 import subprocess
 import sys
@@ -57,13 +58,31 @@ def help_experiment(name: str) -> None:
     "run", context_settings={"ignore_unknown_options": True}
 )
 @click.argument("name")
+@click.option(
+    "--profile",
+    "profile_dir",
+    metavar="DIR",
+    default=None,
+    help="wrap the run in a jax.profiler trace written to DIR "
+    "(bench.py's opt-in, promoted to any experiment; view with "
+    "TensorBoard/xprof)",
+)
 @click.argument("args", nargs=-1, type=click.UNPROCESSED)
-def run_experiment(name: str, args: tuple[str, ...]) -> None:
+def run_experiment(
+    name: str, profile_dir: "str | None", args: tuple[str, ...]
+) -> None:
     """Run an example in a subprocess (reference cli.py:162-189)."""
     ex = _discover_examples()
     if name not in ex:
         raise click.ClickException(f"Unknown experiment '{name}'")
-    rc = subprocess.call([sys.executable, "-m", ex[name], *args])
+    env = dict(os.environ)
+    if profile_dir:
+        # The trace happens in the CHILD: hand the dir across as the
+        # Settings env override (examples apply Settings.from_env()
+        # after their profile), and the stage workflow wraps the
+        # experiment in jax.profiler.start/stop_trace.
+        env["TPFL_PROFILING_TRACE_DIR"] = profile_dir
+    rc = subprocess.call([sys.executable, "-m", ex[name], *args], env=env)
     sys.exit(rc)
 
 

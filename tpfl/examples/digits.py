@@ -152,6 +152,10 @@ def digits(args: argparse.Namespace) -> list[Node]:
         return result
     start = time.monotonic()
     Settings.set_standalone_settings()
+    # TPFL_* environment overrides apply AFTER the profile, so the
+    # CLI can steer any knob (tpfl experiment run --profile DIR rides
+    # TPFL_PROFILING_TRACE_DIR through here).
+    Settings.from_env()
 
     n = args.nodes
     ds = rendered_digits(

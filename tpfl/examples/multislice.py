@@ -47,6 +47,7 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
 def main(argv: list[str] | None = None) -> None:
     args = parse_args(argv)
     Settings.set_standalone_settings()
+    Settings.from_env()  # TPFL_* overrides (CLI --profile rides these)
     node = Node(
         create_model("mlp", (28, 28), seed=args.seed),
         rendered_digits(n_train=args.samples, n_test=400, seed=args.seed + args.port),

@@ -87,6 +87,7 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
 
 def scale(args: argparse.Namespace) -> dict[str, float]:
     Settings.set_scale_settings()
+    Settings.from_env()  # TPFL_* overrides (CLI --profile rides these)
     Settings.TRAIN_SET_SIZE = args.train_set_size
     Settings.ELECTION = args.election
     # Digest-based membership costs O(edges) per period (heartbeater

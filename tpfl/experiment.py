@@ -1,14 +1,30 @@
 """Experiment — round counter container (reference
-``p2pfl/experiment.py:21-53``)."""
+``p2pfl/experiment.py:21-53``), plus the per-experiment profiling
+capture: the experiment snapshots ``Settings.PROFILING_TRACE_DIR`` at
+creation, so the stage workflow (which owns the experiment lifecycle)
+can wrap the whole run — StartLearning through finish — in a
+``jax.profiler`` trace without re-reading mutable global state
+mid-experiment. Set by ``tpfl.cli``'s ``experiment run --profile DIR``
+(via the ``TPFL_PROFILING_TRACE_DIR`` environment override) or
+directly; empty means no trace."""
 
 from __future__ import annotations
 
 
 class Experiment:
-    def __init__(self, exp_name: str, total_rounds: int) -> None:
+    def __init__(
+        self, exp_name: str, total_rounds: int, profile_dir: "str | None" = None
+    ) -> None:
         self.exp_name = exp_name
         self.total_rounds = int(total_rounds)
         self.round: int = 0
+        if profile_dir is None:
+            # Captured at experiment creation (function-level import:
+            # this module stays foundation-layer/stdlib-only).
+            from tpfl.settings import Settings
+
+            profile_dir = Settings.PROFILING_TRACE_DIR
+        self.profile_dir: str = profile_dir or ""
 
     def increase_round(self) -> None:
         if self.round is None:

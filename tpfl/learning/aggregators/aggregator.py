@@ -27,7 +27,7 @@ import jax.numpy as jnp
 
 from tpfl.concurrency import make_lock
 from tpfl.learning.model import TpflModel
-from tpfl.management import tracing
+from tpfl.management import profiling, tracing
 from tpfl.management.logger import logger
 from tpfl.settings import Settings
 
@@ -398,6 +398,12 @@ class Aggregator(ABC):
                         time.monotonic() - t_fold,
                         labels={"node": self.node_name},
                     )
+                    # Round attribution: eager folds are "fold" time
+                    # even when they run on a handler thread while the
+                    # learning thread sits in the gossip wait.
+                    profiling.rounds.add(
+                        self.node_name, "fold", time.monotonic() - t_fold
+                    )
                 except Exception as e:
                     logger.debug(
                         self.node_name,
@@ -480,6 +486,9 @@ class Aggregator(ABC):
                 "tpfl_agg_aggregate_seconds",
                 time.monotonic() - t_close,
                 labels={"node": self.node_name},
+            )
+            profiling.rounds.add(
+                self.node_name, "fold", time.monotonic() - t_close
             )
 
     def get_model(self, except_nodes: list[str] | None = None) -> TpflModel | None:

@@ -23,6 +23,7 @@ one TPU-native learner:
 from __future__ import annotations
 
 import threading
+import time
 import zlib
 from functools import partial
 from typing import Any, Callable, Optional
@@ -36,6 +37,7 @@ from flax.training import train_state
 from tpfl.learning.dataset.tpfl_dataset import TpflDataset
 from tpfl.learning.learner import Learner
 from tpfl.learning.model import TpflModel
+from tpfl.management import profiling
 from tpfl.management.logger import logger
 from tpfl.settings import Settings
 
@@ -62,6 +64,10 @@ compile serialization dominates the whole experiment."""
 
 def _shared_program(key: tuple, build: Callable[[], Callable]) -> Callable:
     fn = _SHARED_PROGRAMS.get(key)
+    # Cache traffic is always-on registry accounting (cheap counter):
+    # N learners sharing one program vs N programs is THE compile-cost
+    # lever at 100+ nodes, and the observatory makes it visible.
+    profiling.observatory.cache_event("shared_programs", hit=fn is not None)
     if fn is None:
         fn = _SHARED_PROGRAMS[key] = build()
     return fn
@@ -213,7 +219,16 @@ class JaxLearner(Learner):
         loss_fn = self._loss_fn
         has_aux = self._has_aux()
         key = ("train_epoch", repr(module), loss_fn, has_aux)
-        return _shared_program(key, lambda: self._make_train_epoch(module, loss_fn, has_aux))
+        # Observatory wrap rides the cache: one probe per ARCHITECTURE
+        # (the module tag keeps different configs' signature sets — and
+        # metric labels — apart), recompile detection on every call.
+        return _shared_program(
+            key,
+            lambda: profiling.observatory.wrap(
+                self._make_train_epoch(module, loss_fn, has_aux),
+                f"train_epoch:{profiling.module_tag(module)}",
+            ),
+        )
 
     @staticmethod
     def _make_train_epoch(module: Any, loss_fn: Callable, has_aux: bool) -> Callable:
@@ -237,7 +252,13 @@ class JaxLearner(Learner):
         module = self._module()
         loss_fn = self._loss_fn
         key = ("eval", repr(module), loss_fn, n_classes)
-        return _shared_program(key, lambda: self._make_eval(module, loss_fn, n_classes))
+        return _shared_program(
+            key,
+            lambda: profiling.observatory.wrap(
+                self._make_eval(module, loss_fn, n_classes),
+                f"eval:{profiling.module_tag(module)}",
+            ),
+        )
 
     @staticmethod
     def _make_eval(module: Any, loss_fn: Callable, n_classes: int) -> Callable:
@@ -405,11 +426,16 @@ class JaxLearner(Learner):
         )
         in_exp = self._in_experiment()
         n_steps = 0
+        # Read once per fit: the dispatch/compute split below adds a
+        # block_until_ready the unprofiled path must not pay (and the
+        # A/B comparison needs one consistent answer per fit).
+        prof = profiling.rounds.enabled()
         for epoch in range(self.epochs):
             if self._interrupt.is_set():
                 logger.info(self._addr, f"Training interrupted at epoch {epoch}")
                 break
             xs, ys = batches.stacked(epoch=self._round_counter * 10_000 + epoch)
+            t0 = time.monotonic() if prof else 0.0
             state, loss, acc = self._train_epoch_fn(
                 state,
                 jnp.asarray(xs),
@@ -418,6 +444,16 @@ class JaxLearner(Learner):
                 initial_params,
                 jnp.float32(mu),
             )
+            if prof:
+                # Proper block_until_ready discipline: the async call
+                # returning bounds the HOST dispatch gap; waiting for
+                # the results bounds device compute (+compile on the
+                # first shape). Attributed into the node's open round.
+                t1 = time.monotonic()
+                jax.block_until_ready((state, loss, acc))
+                t2 = time.monotonic()
+                profiling.rounds.add(self._addr, "dispatch", t1 - t0)
+                profiling.rounds.add(self._addr, "train", t2 - t1)
             n_steps += xs.shape[0]
             if in_exp:
                 logger.log_metric(
@@ -540,12 +576,18 @@ def clear_compiled_caches() -> None:
     cycling many architectures accretes compiled programs forever.
     Called from ``SuperLearnerPool.reset()``; safe any time no fit is
     in flight (a fresh experiment simply recompiles, numerically
-    identical — tested)."""
+    identical — tested). Clears are registry-visible
+    (``tpfl_compiled_cache_clears_total`` — the r3 "caches accrete
+    forever" class of bug shows in the entries gauge vs clears counter
+    instead of staying latent)."""
+    dropped = len(_SHARED_PROGRAMS) + len(_TX_CACHE)
     _SHARED_PROGRAMS.clear()
     _TX_CACHE.clear()
     try:
         from tpfl.simulation import batched_fit
 
+        dropped += len(batched_fit._programs)
         batched_fit._programs.clear()
     except Exception:  # simulation may not be importable in slim envs
         pass
+    profiling.observatory.cache_cleared(dropped)

@@ -81,18 +81,17 @@ class NodeMonitor(threading.Thread):
         self._sample_tpu()
 
     def _sample_tpu(self) -> None:
-        """TPU-native extension: HBM usage per local device, if available."""
+        """TPU-native extension: HBM usage per local device, routed
+        through the device-plane observatory's peak tracker
+        (:data:`tpfl.management.profiling.hbm`) — one sampling path
+        feeds both the per-device ``tpfl_hbm_*`` gauges (with the
+        process-lifetime high-water mark) and the per-node dashboard
+        callback this monitor has always served."""
         try:
-            import jax
+            from tpfl.management import profiling
 
-            for d in jax.local_devices():
-                stats = getattr(d, "memory_stats", None)
-                if stats is None:
-                    continue
-                s = stats()
-                if s and "bytes_in_use" in s:
-                    self._emit(
-                        f"hbm_bytes_in_use_dev{d.id}", float(s["bytes_in_use"])
-                    )
+            for dev, in_use, peak in profiling.hbm.sample():
+                self._emit(f"hbm_bytes_in_use_dev{dev}", in_use)
+                self._emit(f"hbm_peak_bytes_dev{dev}", peak)
         except Exception:
             pass

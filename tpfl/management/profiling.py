@@ -1,0 +1,991 @@
+"""Device-plane performance observatory.
+
+PR-5's flight recorder stitched together the HOST and NETWORK plane; the
+remaining perf questions (ROADMAP: MFU vs the shared-weight floor,
+dispatch RTT, pod-scale rounds) are DEVICE-plane questions, and until
+now the machinery to answer them lived as ad-hoc code inside
+``bench.py`` (``_flops_of``, empty-call RTT subtraction, device-side
+``fori_loop`` timing) and ``parallel/scaling.py``. This module makes
+that machinery a first-class, always-available subsystem feeding the
+PR-5 :class:`~tpfl.management.telemetry.MetricsRegistry` /
+:class:`~tpfl.management.telemetry.FlightRecorder`:
+
+- :class:`CompileObservatory` — wraps the jit/lower/compile seams
+  (``jax_learner._shared_program``, ``VmapFederation._build_round*``,
+  ``batched_fit.BatchedFitProgram``): compile wall-time histograms,
+  program-cache hit/miss counters, persistent-cache events lifted from
+  ``jax.monitoring``, and RECOMPILATION detection keyed by
+  (fn, abstract shapes/dtypes of the arguments) with a recompile-storm
+  warning event when one program keeps re-specializing (the silent
+  killer of steady-state throughput — every distinct vmap width or
+  batch shape is a fresh XLA compile).
+- :class:`RoundProfiler` — attributes each federation round's
+  wall-clock into ``train`` / ``dispatch`` / ``fold`` / ``gossip`` /
+  ``host_other`` components (the instrumented sites live in the
+  learner, the batched-fit chunk, the aggregator, and the round
+  stages), plus the REUSABLE device-side timing API generalized out of
+  bench.py: :func:`measure_dispatch_rtt` and :func:`timed_loop` — K
+  iterations inside ONE jitted ``fori_loop`` dispatch, scalar-reduced
+  sync, empty-call RTT subtracted (docs/perf_cnn.md is the methodology
+  anchor; proper ``block_until_ready`` discipline throughout).
+- :class:`CostModel` — ONE FLOPs-accounting path shared by bench.py
+  and ``parallel/scaling.py``: XLA ``cost_analysis`` flops (with the
+  scan-counted-once caveat in exactly one place), analytic model flops
+  for the zoo architectures (2·M·K·N per layer, x3 fwd+bwd), peak
+  FLOP/s lookup per device kind, and live per-round MFU gauges.
+- :class:`HbmTracker` — per-device HBM high-water-mark gauges lifted
+  from ``node_monitor``'s ``memory_stats`` read into a peak-tracking
+  registry collector.
+- a **perf regression gate** (:func:`compare_to_baseline`) — compares
+  a bench run's parsed metrics against a committed baseline with
+  per-metric tolerance thresholds and a machine-readable pass/fail
+  verdict; ``bench.py --check`` and the CI perf-smoke job are thin
+  shells over it.
+
+Gating: the metrics REGISTRY side (cache hit/miss counters, cache-size
+gauges, HBM gauges) always records — cheap dict updates, PR-5's rule.
+Everything that costs per-call work on a hot path (abstract-signature
+extraction in :meth:`CompileObservatory.wrap`, round spans, the
+``block_until_ready`` splits in the learner) is gated by
+``Settings.PROFILING_ENABLED`` and collapses to one attribute read
+when off — disabled profiling adds ZERO device dispatches and no
+measurable rounds/sec (bench.py's profiling tier A/B is the receipt).
+
+Concurrency: each tracker's shared state sits under its own
+``make_lock`` leaf lock, never held while calling out of this module
+(same discipline as telemetry.py). jax is imported lazily so importing
+the management layer stays backend-free.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import sys
+import time
+import zlib
+from collections import deque
+from typing import Any, Callable, Iterator, Optional
+
+from tpfl.concurrency import make_lock
+from tpfl.management.telemetry import flight, metrics
+from tpfl.settings import Settings
+
+#: Peak dense bf16 FLOP/s per chip by device kind (public specs) — the
+#: single copy; bench.py's former ``_PEAK_FLOPS`` is this table.
+PEAK_FLOPS: dict[str, float] = {
+    "TPU v5 lite": 197e12,  # v5e
+    "TPU v5e": 197e12,
+    "TPU v4": 275e12,
+    "TPU v6 lite": 918e12,  # v6e / Trillium
+}
+
+#: Compile wall times span ms (cache hit replay) to minutes (the big
+#: vmapped round programs) — the default seconds-flavored buckets top
+#: out at 10 s and would collapse every real compile into +Inf.
+COMPILE_BUCKETS: tuple[float, ...] = (
+    0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 15.0, 30.0, 60.0, 120.0, 300.0,
+)
+
+#: Round components run 10 ms (device round) to minutes (timeout-bound
+#: protocol rounds).
+ROUND_BUCKETS: tuple[float, ...] = (
+    0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+)
+
+#: The flight-recorder ring profiling events land in (a pseudo-node:
+#: compile storms are process-scoped, not owned by any one federation
+#: node).
+PROFILING_RING = "_profiling"
+
+#: Round attribution component names (the five buckets the ISSUE and
+#: bench.py's profiling tier report). ``host_other`` is the residual:
+#: wall minus everything measured — attribution that cannot silently
+#: drop time.
+COMPONENTS = ("train", "dispatch", "fold", "gossip", "host_other")
+
+
+def peak_flops(device: Any) -> "float | None":
+    """Peak dense FLOP/s for a jax device, or None when unknown."""
+    kind = getattr(device, "device_kind", "") or ""
+    for k, v in PEAK_FLOPS.items():
+        if kind.startswith(k):
+            return v
+    return None
+
+
+# --- compile observatory --------------------------------------------------
+
+
+def _abstract_signature(args: tuple, kwargs: dict) -> tuple:
+    """Hashable abstraction of a call's arguments — what jit's cache
+    key sees, approximately: (shape, dtype) per array leaf, VALUES for
+    ints/bools/strs (static argnums recompile on value change), type
+    only for floats (usually data, not structure)."""
+    import jax
+
+    out = []
+    for leaf in jax.tree_util.tree_leaves((args, kwargs)):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is not None and dtype is not None:
+            out.append(("a", tuple(shape), str(dtype)))
+        elif isinstance(leaf, (int, bool, str)):
+            out.append(("s", leaf))
+        elif leaf is None:
+            out.append(("n",))
+        else:
+            out.append(("t", type(leaf).__name__))
+    return tuple(out)
+
+
+def module_tag(module: Any) -> str:
+    """Short stable tag for an architecture — disambiguates per-fn
+    signature sets (and metric labels) when several module configs
+    share one program name, without unbounded label cardinality."""
+    return f"{zlib.crc32(repr(module).encode()) & 0xFFFF:04x}"
+
+
+class CompileObservatory:
+    """Compile-seam accounting: cache hits/misses, compile wall time,
+    recompile detection keyed by (fn, abstract shapes/dtypes).
+
+    Two halves:
+
+    - ALWAYS-ON counters (plain registry updates, PR-5 rule): the
+      process program-cache traffic (:meth:`cache_event`,
+      :meth:`cache_cleared`) — how the r3 "caches accrete forever" bug
+      class becomes visible instead of latent.
+    - GATED per-call work (``Settings.PROFILING_ENABLED``):
+      :meth:`wrap` puts a signature probe in front of a jitted
+      callable; a never-seen (fn, signature) is a (re)compilation —
+      its call is timed into ``tpfl_compile_seconds`` (compile +
+      first-run wall; jit exposes no cleaner split without a separate
+      lower/compile, which :meth:`compile_span` serves for callers
+      that do lower explicitly), and when one fn accretes
+      ``Settings.PROFILING_RECOMPILE_WARN`` distinct signatures a
+      ``recompile_storm`` event lands in the flight ring and the log.
+    """
+
+    def __init__(self) -> None:
+        self._lock = make_lock("CompileObservatory._lock")
+        # guarded-by: _lock
+        self._signatures: dict[str, set] = {}
+        # guarded-by: _lock
+        self._warned: set[str] = set()
+        # unguarded: single flag flipped under _lock in _install only;
+        # racy double-read would at worst double-install a no-op pair.
+        self._listeners_installed = False
+
+    # --- always-on cache accounting ---
+
+    def cache_event(self, cache: str, hit: bool) -> None:
+        """One lookup against a process-lifetime compiled-program cache
+        (``jax_learner._SHARED_PROGRAMS``, ``batched_fit._programs``,
+        per-program shape caches...)."""
+        metrics.counter(
+            "tpfl_compiled_cache_requests_total",
+            labels={"cache": cache, "result": "hit" if hit else "miss"},
+        )
+
+    def cache_cleared(self, dropped: int) -> None:
+        """``clear_compiled_caches`` ran; ``dropped`` programs freed."""
+        metrics.counter("tpfl_compiled_cache_clears_total")
+        metrics.counter("tpfl_compiled_cache_dropped_total", float(dropped))
+
+    # --- gated recompile detection ---
+
+    def wrap(self, fn: Callable, name: str) -> Callable:
+        """Signature-probe wrapper around a jitted callable. With
+        profiling off the wrapper is one attribute read + passthrough
+        (zero added dispatches); with it on, each call abstracts its
+        arguments and a fresh signature counts (and times) as a
+        compilation."""
+        self._install_jax_listeners()
+
+        def observed(*args: Any, **kwargs: Any) -> Any:
+            if not Settings.PROFILING_ENABLED:
+                return fn(*args, **kwargs)
+            sig = _abstract_signature(args, kwargs)
+            fresh, n_sigs = self._note(name, sig)
+            if not fresh:
+                metrics.counter(
+                    "tpfl_compile_signature_hits_total", labels={"fn": name}
+                )
+                return fn(*args, **kwargs)
+            t0 = time.perf_counter()
+            out = fn(*args, **kwargs)
+            dt = time.perf_counter() - t0
+            metrics.observe(
+                "tpfl_compile_seconds", dt,
+                labels={"fn": name}, buckets=COMPILE_BUCKETS,
+            )
+            metrics.gauge(
+                "tpfl_compile_signatures", float(n_sigs), labels={"fn": name}
+            )
+            if n_sigs > 1:
+                metrics.counter("tpfl_recompiles_total", labels={"fn": name})
+            self._maybe_warn_storm(name, n_sigs)
+            return out
+
+        # Keep the lowering escape hatch callers like bench's flops
+        # estimator use on raw jitted fns.
+        lower = getattr(fn, "lower", None)
+        if lower is not None:
+            observed.lower = lower  # type: ignore[attr-defined]
+        observed.__wrapped__ = fn  # type: ignore[attr-defined]
+        return observed
+
+    def _note(self, name: str, sig: tuple) -> tuple[bool, int]:
+        with self._lock:
+            seen = self._signatures.setdefault(name, set())
+            if sig in seen:
+                return False, len(seen)
+            seen.add(sig)
+            return True, len(seen)
+
+    def _maybe_warn_storm(self, name: str, n_sigs: int) -> None:
+        warn_at = max(2, int(Settings.PROFILING_RECOMPILE_WARN))
+        if n_sigs < warn_at:
+            return
+        with self._lock:
+            if name in self._warned:
+                return
+            self._warned.add(name)
+        # Outside _lock: the ring and logger take their own locks.
+        flight.record(
+            PROFILING_RING,
+            {
+                "kind": "event",
+                "name": "recompile_storm",
+                "node": PROFILING_RING,
+                "trace": "",
+                "t": time.monotonic(),
+                "fn": name,
+                "signatures": n_sigs,
+            },
+        )
+        from tpfl.management.logger import logger
+
+        logger.warning(
+            PROFILING_RING,
+            f"Recompile storm: '{name}' compiled for {n_sigs} distinct "
+            f"argument signatures (threshold "
+            f"{warn_at}) — shape/dtype churn is defeating the jit cache",
+        )
+
+    @contextlib.contextmanager
+    def compile_span(self, name: str) -> Iterator[None]:
+        """Time an explicit lower/compile block into the compile
+        histogram (for callers that hold the seam open themselves,
+        e.g. ``.lower(...).compile()`` in scaling analysis/bench)."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            metrics.observe(
+                "tpfl_compile_seconds", time.perf_counter() - t0,
+                labels={"fn": name}, buckets=COMPILE_BUCKETS,
+            )
+
+    def signature_counts(self) -> dict[str, int]:
+        """fn name -> distinct abstract signatures seen (tests/bench)."""
+        with self._lock:
+            return {k: len(v) for k, v in self._signatures.items()}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._signatures.clear()
+            self._warned.clear()
+
+    # --- persistent-cache / backend-compile events (jax.monitoring) ---
+
+    def _install_jax_listeners(self) -> None:
+        """Mirror jax's own monitoring events (persistent compilation
+        cache hits/misses, backend compile durations) into the
+        registry. Listeners are global and permanent in jax, so they
+        install once and gate per-event on PROFILING_ENABLED."""
+        if self._listeners_installed:
+            return
+        with self._lock:
+            if self._listeners_installed:
+                return
+            self._listeners_installed = True
+        try:
+            import jax.monitoring as jmon
+
+            def on_event(event: str, **kw: Any) -> None:
+                if not Settings.PROFILING_ENABLED:
+                    return
+                if "cache" in event or "compile" in event:
+                    metrics.counter(
+                        "tpfl_jax_monitoring_events_total",
+                        labels={"event": event.rsplit("/", 1)[-1]},
+                    )
+
+            def on_duration(event: str, duration: float, **kw: Any) -> None:
+                if not Settings.PROFILING_ENABLED:
+                    return
+                if "compile" in event:
+                    metrics.observe(
+                        "tpfl_jax_compile_seconds", float(duration),
+                        labels={"event": event.rsplit("/", 1)[-1]},
+                        buckets=COMPILE_BUCKETS,
+                    )
+
+            jmon.register_event_listener(on_event)
+            jmon.register_event_duration_secs_listener(on_duration)
+        except Exception:
+            pass  # older jax without monitoring: counters stay silent
+
+
+# --- round profiler -------------------------------------------------------
+
+
+class _RoundSpan:
+    """Accumulating component timer (``with rounds.span(node, comp):``)."""
+
+    __slots__ = ("_profiler", "_node", "_component", "_t0")
+
+    def __init__(self, profiler: "RoundProfiler", node: str, component: str) -> None:
+        self._profiler = profiler
+        self._node = node
+        self._component = component
+        self._t0 = 0.0
+
+    def __enter__(self) -> "_RoundSpan":
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc: object) -> None:
+        self._profiler.add(
+            self._node, self._component, time.monotonic() - self._t0
+        )
+
+
+class _NullSpan:
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc: object) -> None:
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class RoundProfiler:
+    """Per-round wall-clock attribution.
+
+    ``begin_round(node, round)`` opens a round window (the vote stage),
+    instrumented sites accumulate seconds into named components
+    (:data:`COMPONENTS`) via :meth:`add` / :meth:`span`, and
+    ``end_round`` (the round-finished stage) closes the window:
+    ``host_other`` is the residual (wall minus everything measured, so
+    attribution can never silently drop time), per-component seconds
+    land in ``tpfl_round_attr_seconds{node,component}`` histograms and
+    a ``round`` span in the node's flight ring, and the completed
+    record is retained for :meth:`attribution` (bench/tests).
+
+    Components may OVERLAP in wall time (an eager fold on a gRPC
+    handler thread runs while the learning thread sits in the gossip
+    wait), so the measured sum can exceed the wall; coverage is
+    reported, not clamped. Everything is gated by
+    ``Settings.PROFILING_ENABLED`` — off means no-op spans and zero
+    bookkeeping.
+    """
+
+    def __init__(self) -> None:
+        self._lock = make_lock("RoundProfiler._lock")
+        # guarded-by: _lock
+        self._active: dict[str, dict] = {}
+        # guarded-by: _lock
+        self._done: deque = deque(maxlen=1024)
+
+    def enabled(self) -> bool:
+        return bool(Settings.PROFILING_ENABLED)
+
+    def begin_round(self, node: str, round: "int | None") -> None:
+        if not Settings.PROFILING_ENABLED:
+            return
+        with self._lock:
+            self._active[node] = {
+                "node": node,
+                "round": round if round is not None else -1,
+                "t0": time.monotonic(),
+                "parts": dict.fromkeys(
+                    ("train", "dispatch", "fold", "gossip"), 0.0
+                ),
+            }
+
+    def add(self, node: str, component: str, seconds: float) -> None:
+        """Accumulate measured seconds into the node's OPEN round (a
+        no-op outside a round window — bare learner fits in tests don't
+        need a federation round to exist)."""
+        if not Settings.PROFILING_ENABLED or seconds <= 0:
+            return
+        with self._lock:
+            rec = self._active.get(node)
+            if rec is not None:
+                parts = rec["parts"]
+                parts[component] = parts.get(component, 0.0) + seconds
+
+    def span(self, node: str, component: str) -> "_RoundSpan | _NullSpan":
+        if not Settings.PROFILING_ENABLED:
+            return _NULL_SPAN
+        return _RoundSpan(self, node, component)
+
+    def end_round(self, node: str, round: "int | None") -> "dict | None":
+        if not Settings.PROFILING_ENABLED:
+            return None
+        now = time.monotonic()
+        with self._lock:
+            rec = self._active.pop(node, None)
+        if rec is None:
+            return None
+        wall = max(now - rec["t0"], 1e-9)
+        parts = rec["parts"]
+        measured = sum(parts.values())
+        parts["host_other"] = max(0.0, wall - measured)
+        record = {
+            "node": node,
+            "round": rec["round"],
+            "wall": wall,
+            "parts": parts,
+            # components (incl. the residual) over wall: ~1.0 unless
+            # concurrent components overlapped past the wall itself.
+            "coverage": (measured + parts["host_other"]) / wall,
+            "measured_frac": measured / wall,
+        }
+        with self._lock:
+            self._done.append(record)
+        for comp, secs in parts.items():
+            metrics.observe(
+                "tpfl_round_attr_seconds", secs,
+                labels={"node": node, "component": comp},
+                buckets=ROUND_BUCKETS,
+            )
+        metrics.observe(
+            "tpfl_round_wall_seconds", wall,
+            labels={"node": node}, buckets=ROUND_BUCKETS,
+        )
+        flight.record(
+            node,
+            {
+                "kind": "span",
+                "name": "round",
+                "node": node,
+                "trace": "",
+                "t0": rec["t0"],
+                "t1": now,
+                "round": record["round"],
+                **{f"s_{k}": round_(v) for k, v in parts.items()},
+            },
+        )
+        return record
+
+    def attribution(self, node: "str | None" = None) -> list[dict]:
+        """Completed round records (optionally one node's), oldest
+        first — the bench profiling tier / test surface."""
+        with self._lock:
+            records = list(self._done)
+        if node is not None:
+            records = [r for r in records if r["node"] == node]
+        return records
+
+    def reset(self) -> None:
+        with self._lock:
+            self._active.clear()
+            self._done.clear()
+
+
+def round_(v: float, nd: int = 6) -> float:
+    """round() under a name that doesn't shadow the round kwargs used
+    throughout the profiler API."""
+    return round(v, nd)
+
+
+# --- device-side timing (the bench methodology, as an API) ---------------
+
+
+def measure_dispatch_rtt(best_of: int = 3) -> float:
+    """Seconds for one dispatch+sync round trip of a trivially small
+    jitted program — the empty-call baseline :func:`timed_loop`
+    subtracts. On a tunneled TPU this is ~100 ms, the same order as a
+    whole federated round (docs/perf_cnn.md), which is why host-loop
+    timing misattributes it."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    @jax.jit
+    def empty_call(x):
+        return lax.fori_loop(0, 100, lambda i, a: a + x * (1 + i), jnp.float32(0))
+
+    rtt, _ = best_of_wall(empty_call, (jnp.float32(1),), best_of)
+    return rtt
+
+
+def best_of_wall(fn: Callable, args: tuple, n: int = 3) -> tuple[float, Any]:
+    """Best-of-n wall time of ``fn(*args)`` with a SCALAR host sync on
+    the last output leaf (perf_cnn.md round-5 trap #1: syncing by
+    copying an array carry measures the tunnel, not the device).
+    Returns ``(best_seconds, last_outputs)``. The first call is a
+    discarded compile/warm run."""
+    import jax
+    import numpy as np
+
+    out = fn(*args)  # compile + warm
+    float(np.asarray(jax.tree_util.tree_leaves(out)[-1]).ravel()[0])
+    best = float("inf")
+    for _ in range(max(1, n)):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        float(np.asarray(jax.tree_util.tree_leaves(out)[-1]).ravel()[0])
+        best = min(best, time.perf_counter() - t0)
+    return best, out
+
+
+def timed_loop(
+    step: Callable,
+    carry: Any,
+    data: tuple,
+    n_iters: int,
+    rtt: "float | None" = None,
+    best_of: int = 3,
+) -> tuple[float, Any]:
+    """Seconds per iteration of ``step(carry, *data) -> carry`` — the
+    canonical device-side methodology every bench tier shares, now a
+    reusable API (generalized out of ``bench.py``):
+
+    - ``n_iters`` iterations run inside ONE jitted ``fori_loop``
+      dispatch (host-loop timing misattributes the ~100 ms tunnel RTT
+      to the device);
+    - the program returns ONE f32 scalar reduced from every carry leaf
+      (observes all outputs — no dead-code elimination — while the
+      host sync copies 4 bytes, not an array carry);
+    - a measured empty-call RTT is subtracted (pass ``rtt`` to share
+      one measurement across tiers; None measures it here);
+    - best of ``best_of`` runs.
+
+    ``data`` rides as ARGUMENTS, not closure constants — closures embed
+    the arrays into the program and the remote compile service rejects
+    the request body. Size ``n_iters`` so the device work dwarfs the
+    ±15 ms RTT drift (perf_cnn.md round-5 trap #2). Returns
+    ``(seconds_per_iter, final_outputs)``."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    if rtt is None:
+        rtt = measure_dispatch_rtt(best_of)
+
+    @jax.jit
+    def run(c, *d):
+        out = lax.fori_loop(0, n_iters, lambda i, cc: step(cc, *d), c)
+        leaves = jax.tree_util.tree_leaves(out)
+        return sum(x.ravel()[0].astype(jnp.float32) for x in leaves)
+
+    total, out = best_of_wall(run, (carry, *data), best_of)
+    return max(total - rtt, 1e-9) / n_iters, out
+
+
+# --- cost model -----------------------------------------------------------
+
+
+class CostModel:
+    """Unified FLOPs / MFU accounting — the ONE ``cost_analysis()``
+    call path shared by ``bench.py`` and
+    ``parallel/scaling.py:analyze_compiled``, so static scaling
+    analysis and live MFU can never disagree."""
+
+    @staticmethod
+    def cost_analysis(compiled: Any) -> dict:
+        """XLA's cost analysis dict for a compiled executable (older
+        jax returns ``[dict]`` — normalized here, once, for everyone)."""
+        cost = compiled.cost_analysis()
+        if isinstance(cost, list):
+            cost = cost[0]
+        return dict(cost or {})
+
+    @classmethod
+    def xla_flops(cls, compiled: Any) -> "float | None":
+        """XLA's flop count for an already-compiled executable.
+        Caveat (the one copy of it): a ``lax.scan``/``fori_loop`` body
+        is counted ONCE regardless of trip count — callers must scale
+        by the number of steps themselves."""
+        try:
+            return float(cls.cost_analysis(compiled).get("flops", 0.0)) or None
+        except Exception:
+            return None
+
+    # --- analytic model flops (immune to scan-once counting and to
+    # custom-VJP lowering; derived from the zoo modules' actual config
+    # so a model change can never silently desynchronize MFU) ---
+
+    @staticmethod
+    def analytic_fwd_mults(
+        module: Any, input_shape: tuple[int, ...]
+    ) -> "int | None":
+        """Per-sample forward multiply count for the zoo architectures
+        (2x per mult = FLOPs). Supports the zoo ``CNN`` (3x3 SAME
+        convs + 2x2 max-pool + dense head) and ``MLP`` (dense stack);
+        returns None for architectures without an analytic model —
+        callers fall back to :meth:`xla_flops`."""
+        channels = getattr(module, "channels", None)
+        dense = getattr(module, "dense", None)
+        out_channels = getattr(module, "out_channels", None)
+        hidden = getattr(module, "hidden_sizes", None)
+        if channels is not None and dense is not None and out_channels is not None:
+            if len(input_shape) != 3:
+                return None
+            h, w, cin = input_shape
+            mults = 0
+            for c in channels:
+                mults += h * w * 9 * cin * c  # 3x3 SAME conv
+                cin = c
+                h //= 2
+                w //= 2  # 2x2 max-pool
+            mults += (h * w * cin) * dense
+            mults += dense * out_channels
+            return int(mults)
+        if hidden is not None and out_channels is not None:
+            features = 1
+            for d in input_shape:
+                features *= d
+            mults = 0
+            for width in tuple(hidden) + (out_channels,):
+                mults += features * width
+                features = width
+            return int(mults)
+        return None
+
+    @classmethod
+    def analytic_train_flops(
+        cls, module: Any, input_shape: tuple[int, ...], samples: int
+    ) -> "float | None":
+        """Model FLOPs of training on ``samples`` samples: 2 FLOPs per
+        mult, x3 for forward+backward."""
+        mults = cls.analytic_fwd_mults(module, input_shape)
+        if mults is None:
+            return None
+        return 3.0 * 2.0 * mults * samples
+
+    # --- MFU ---
+
+    @staticmethod
+    def mfu(
+        flops_per_sec: float,
+        device: Any = None,
+        n_chips: int = 1,
+    ) -> "float | None":
+        """Model-FLOPs utilization against the device's peak (None when
+        the device kind has no published peak — CPU CI runs)."""
+        if device is None:
+            import jax
+
+            device = jax.devices()[0]
+        peak = peak_flops(device)
+        if not peak:
+            return None
+        return flops_per_sec / (peak * max(1, n_chips))
+
+    @classmethod
+    def record_round(
+        cls,
+        program: str,
+        flops: float,
+        seconds: float,
+        device: Any = None,
+        n_chips: int = 1,
+    ) -> "float | None":
+        """Publish one round's live MFU: ``tpfl_mfu{program}`` /
+        ``tpfl_round_flops{program}`` gauges plus the per-round seconds
+        histogram. Returns the MFU (None off-TPU). This is the gauge
+        bench.py's profiling tier cross-checks against the analytic
+        MFU column."""
+        seconds = max(seconds, 1e-12)
+        value = cls.mfu(flops / seconds, device=device, n_chips=n_chips)
+        metrics.gauge(
+            "tpfl_round_flops", float(flops), labels={"program": program}
+        )
+        metrics.observe(
+            "tpfl_round_compute_seconds", seconds,
+            labels={"program": program}, buckets=ROUND_BUCKETS,
+        )
+        if value is not None:
+            metrics.gauge("tpfl_mfu", float(value), labels={"program": program})
+        return value
+
+
+# --- HBM high-water marks -------------------------------------------------
+
+
+class HbmTracker:
+    """Per-device HBM gauges with a process-lifetime HIGH-WATER MARK.
+
+    ``node_monitor`` samples on its cadence; the tracker is also a
+    registry collector so a scrape/dump observes fresh values even
+    with no monitor running. TPU runtimes report
+    ``peak_bytes_in_use`` themselves where available; the tracker
+    additionally maxes over its own samples so backends that only
+    report ``bytes_in_use`` still get a peak."""
+
+    def __init__(self) -> None:
+        self._lock = make_lock("HbmTracker._lock")
+        # guarded-by: _lock
+        self._peaks: dict[str, float] = {}
+
+    def sample(self) -> list[tuple[str, float, float]]:
+        """[(device_id, bytes_in_use, peak_bytes)] for every local
+        device exposing ``memory_stats``; updates the registry gauges
+        (``tpfl_hbm_bytes_in_use`` / ``tpfl_hbm_peak_bytes``, labeled
+        by device). Host-side reads only — zero device dispatches."""
+        if "jax" not in sys.modules:
+            return []  # never the import that drags a backend in
+        out: list[tuple[str, float, float]] = []
+        try:
+            import jax
+
+            for d in jax.local_devices():
+                stats_fn = getattr(d, "memory_stats", None)
+                if stats_fn is None:
+                    continue
+                try:
+                    stats = stats_fn()
+                except Exception:
+                    continue
+                if not stats or "bytes_in_use" not in stats:
+                    continue
+                out.append(self._record(str(d.id), stats))
+        except Exception:
+            return out
+        return out
+
+    def _record(self, dev: str, stats: dict) -> tuple[str, float, float]:
+        in_use = float(stats["bytes_in_use"])
+        reported_peak = float(stats.get("peak_bytes_in_use", 0.0))
+        with self._lock:
+            peak = max(self._peaks.get(dev, 0.0), in_use, reported_peak)
+            self._peaks[dev] = peak
+        labels = {"device": dev}
+        metrics.gauge("tpfl_hbm_bytes_in_use", in_use, labels=labels)
+        metrics.gauge("tpfl_hbm_peak_bytes", peak, labels=labels)
+        return dev, in_use, peak
+
+    def observe(self, dev: str, stats: dict) -> tuple[str, float, float]:
+        """Fold one externally-sampled ``memory_stats`` dict (tests /
+        exotic backends) through the same peak tracking."""
+        return self._record(dev, stats)
+
+    def peaks(self) -> dict[str, float]:
+        with self._lock:
+            return dict(self._peaks)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._peaks.clear()
+
+
+# --- compiled-program cache visibility (pull-style collector) ------------
+
+
+def _compiled_cache_collector(registry: Any) -> None:
+    """Registry collector: sizes of the process-lifetime compiled
+    program caches (``jax_learner._SHARED_PROGRAMS`` / ``_TX_CACHE``,
+    ``batched_fit._programs`` + per-program shape caches). Reads ONLY
+    modules already imported (``sys.modules`` peek — a metrics scrape
+    must never be the thing that imports the learning stack)."""
+    jl = sys.modules.get("tpfl.learning.jax_learner")
+    if jl is not None:
+        registry.gauge(
+            "tpfl_compiled_cache_entries",
+            float(len(jl._SHARED_PROGRAMS)),
+            labels={"cache": "shared_programs"},
+        )
+        registry.gauge(
+            "tpfl_compiled_cache_entries",
+            float(len(jl._TX_CACHE)),
+            labels={"cache": "tx"},
+        )
+    bf = sys.modules.get("tpfl.simulation.batched_fit")
+    if bf is not None:
+        programs = list(bf._programs.values())
+        registry.gauge(
+            "tpfl_compiled_cache_entries",
+            float(len(programs)),
+            labels={"cache": "batched_programs"},
+        )
+        registry.gauge(
+            "tpfl_compiled_cache_entries",
+            float(sum(len(p._fns) for p in programs)),
+            labels={"cache": "batched_shape_fns"},
+        )
+
+
+def _hbm_collector(registry: Any) -> None:
+    hbm.sample()
+
+
+# --- jax.profiler trace wrap (any run, not just bench) -------------------
+
+_trace_lock = make_lock("profiling._trace_lock")
+_trace_dir: "list[str]" = []  # 0- or 1-element; guarded by _trace_lock
+
+
+def start_trace(directory: str) -> bool:
+    """Start a process-wide ``jax.profiler`` trace into ``directory``
+    (idempotent: a second start while one is active is a no-op —
+    several in-process nodes share one profiler). Returns True when
+    this call actually started it."""
+    if not directory:
+        return False
+    with _trace_lock:
+        if _trace_dir:
+            return False
+        _trace_dir.append(directory)
+    try:
+        import jax
+
+        jax.profiler.start_trace(directory)
+        return True
+    except Exception as e:
+        with _trace_lock:
+            _trace_dir.clear()
+        from tpfl.management.logger import logger
+
+        logger.warning(PROFILING_RING, f"jax.profiler trace failed: {e}")
+        return False
+
+
+def stop_trace() -> bool:
+    """Stop the active trace, if any (idempotent)."""
+    with _trace_lock:
+        if not _trace_dir:
+            return False
+        directory = _trace_dir.pop()
+    try:
+        import jax
+
+        jax.profiler.stop_trace()
+        from tpfl.management.logger import logger
+
+        logger.info(
+            PROFILING_RING,
+            f"jax.profiler trace written to {directory} "
+            "(view with TensorBoard/xprof)",
+        )
+        return True
+    except Exception:
+        return False
+
+
+@contextlib.contextmanager
+def maybe_trace(directory: "str | None") -> Iterator[None]:
+    """Wrap a block in a jax profiler trace when ``directory`` is
+    set; a shared no-op otherwise (bench's ``--profile`` and the CLI's
+    ``experiment run --profile`` both ride this)."""
+    started = start_trace(directory) if directory else False
+    try:
+        yield
+    finally:
+        if started:
+            stop_trace()
+
+
+# --- perf regression gate -------------------------------------------------
+
+#: Default per-metric relative tolerance for the regression gate.
+DEFAULT_TOLERANCE = 0.2
+
+
+def resolve_path(doc: Any, path: str) -> Any:
+    """Dotted-path lookup into a bench result document
+    (``"extra.mfu"`` → ``doc["extra"]["mfu"]``); None when missing."""
+    cur = doc
+    for part in path.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def compare_to_baseline(results: dict, baseline: dict) -> dict:
+    """The perf regression gate: compare a bench run's parsed metrics
+    against a committed baseline document.
+
+    Baseline schema (``BENCH_BASELINE*.json``)::
+
+        {"metrics": {
+            "<name>": {"path": "extra.mfu", "baseline": 0.105,
+                        "direction": "higher",     # or "lower"
+                        "tolerance": 0.2,          # relative, optional
+                        "required": false},        # missing => fail?
+         ...}}
+
+    A ``higher``-direction metric regresses when
+    ``value < baseline * (1 - tolerance)``; ``lower`` (bytes, seconds)
+    when ``value > baseline * (1 + tolerance)``. Booleans coerce to
+    1.0/0.0 so acceptance flags gate too. Metrics absent from the run
+    are SKIPPED unless ``required`` (CPU smoke runs don't produce the
+    TPU tiers). Returns the machine-readable verdict
+    ``{"pass": bool, "checked": [...], "skipped": [...]}`` that
+    ``bench.py --check`` prints and exits on."""
+    checked: list[dict] = []
+    skipped: list[dict] = []
+    ok_all = True
+    for name, spec in sorted(baseline.get("metrics", {}).items()):
+        path = spec.get("path", name)
+        base = spec.get("baseline")
+        value = resolve_path(results, path)
+        if isinstance(value, bool):
+            value = 1.0 if value else 0.0
+        if isinstance(base, bool):
+            base = 1.0 if base else 0.0
+        if value is None or not isinstance(value, (int, float)):
+            entry = {"metric": name, "path": path, "status": "missing"}
+            if spec.get("required", False):
+                entry["ok"] = False
+                checked.append(entry)
+                ok_all = False
+            else:
+                skipped.append(entry)
+            continue
+        if not isinstance(base, (int, float)) or base == 0:
+            skipped.append(
+                {"metric": name, "path": path, "status": "bad_baseline"}
+            )
+            continue
+        tolerance = float(spec.get("tolerance", DEFAULT_TOLERANCE))
+        direction = spec.get("direction", "higher")
+        ratio = float(value) / float(base)
+        if direction == "lower":
+            ok = ratio <= 1.0 + tolerance
+        else:
+            ok = ratio >= 1.0 - tolerance
+        checked.append(
+            {
+                "metric": name,
+                "path": path,
+                "value": value,
+                "baseline": base,
+                "ratio": round(ratio, 4),
+                "direction": direction,
+                "tolerance": tolerance,
+                "ok": ok,
+            }
+        )
+        ok_all = ok_all and ok
+    return {"pass": bool(ok_all), "checked": checked, "skipped": skipped}
+
+
+#: Process-wide singletons (one federation per process in every
+#: simulation mode — same scope rationale as telemetry.metrics/flight).
+observatory = CompileObservatory()
+rounds = RoundProfiler()
+cost_model = CostModel()
+hbm = HbmTracker()
+
+metrics.register_collector(_compiled_cache_collector)
+metrics.register_collector(_hbm_collector)

@@ -196,6 +196,12 @@ class Node:
             from tpfl.concurrency import lock_graph
 
             lock_graph.assert_acyclic()
+        # A profiler trace left open by an aborted experiment would
+        # otherwise never flush to disk (idempotent no-op normally —
+        # the experiment-finished path already closed it).
+        from tpfl.management import profiling
+
+        profiling.stop_trace()
 
     # --- topology (reference node.py:140-184) ---
 

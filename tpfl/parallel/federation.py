@@ -25,6 +25,7 @@ import optax
 from jax.sharding import Mesh
 
 from tpfl.learning.jax_learner import cross_entropy_loss, default_optimizer
+from tpfl.management import profiling
 from tpfl.parallel.mesh import federation_sharding, replicated
 
 
@@ -506,7 +507,14 @@ class VmapFederation:
                     "(init_scaffold_state(params))"
                 )
             if self._round_scaffold_fn is None:
-                self._round_scaffold_fn = self._build_round_scaffold()
+                # Observatory wrap at the API seam (not inside the
+                # builders): bench drives the raw _build_round* fns
+                # from inside its own jitted loops, where a per-call
+                # probe would execute at trace time and record junk.
+                self._round_scaffold_fn = profiling.observatory.wrap(
+                    self._build_round_scaffold(),
+                    f"vmap_round_scaffold:{profiling.module_tag(self.module)}",
+                )
             c_locals, c_global = scaffold_state
             params, c_locals, c_global, aux_out, losses = (
                 self._round_scaffold_fn(
@@ -517,10 +525,16 @@ class VmapFederation:
             return params, aux_out, (c_locals, c_global), losses
         if aux is not None:
             if self._round_aux_fn is None:
-                self._round_aux_fn = self._build_round_aux()
+                self._round_aux_fn = profiling.observatory.wrap(
+                    self._build_round_aux(),
+                    f"vmap_round_aux:{profiling.module_tag(self.module)}",
+                )
             return self._round_aux_fn(params, aux, xs, ys, weights, epochs)
         if self._round_fn is None:
-            self._round_fn = self._build_round()
+            self._round_fn = profiling.observatory.wrap(
+                self._build_round(),
+                f"vmap_round:{profiling.module_tag(self.module)}",
+            )
         return self._round_fn(params, xs, ys, weights, epochs)
 
     # --- evaluation ---

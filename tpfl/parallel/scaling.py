@@ -19,6 +19,8 @@ from __future__ import annotations
 import re
 from typing import Any
 
+from tpfl.management.profiling import cost_model
+
 _DTYPE_BYTES = {
     "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "bf16": 2, "f16": 2,
     "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8, "c64": 8,
@@ -67,13 +69,16 @@ def collective_bytes(hlo_text: str) -> dict[str, int]:
 
 def analyze_compiled(compiled: Any) -> dict[str, Any]:
     """{"flops": per-device flops, "collectives": {kind: bytes},
-    "collective_bytes": total}."""
-    cost = compiled.cost_analysis()
-    if isinstance(cost, list):  # older jax returns [dict]
-        cost = cost[0]
+    "collective_bytes": total}.
+
+    FLOPs come from the shared :class:`~tpfl.management.profiling
+    .CostModel` — the ONE ``cost_analysis()`` call path (bench.py's
+    live MFU uses the same one, with the same scan-counted-once
+    caveat), so static scaling analysis and live MFU can never
+    disagree about what a program costs."""
     coll = collective_bytes(compiled.as_text())
     return {
-        "flops": float(cost.get("flops", 0.0)),
+        "flops": cost_model.xla_flops(compiled) or 0.0,
         "collectives": coll,
         "collective_bytes": sum(coll.values()),
     }

@@ -357,6 +357,39 @@ class Settings:
     to 2.0 — hundreds of waiters waking 2x/s are a measurable GIL tax
     at 1000 in-process nodes."""
 
+    # --- device-plane profiling ---
+    PROFILING_ENABLED: bool = False
+    """Master gate for the device-plane performance observatory
+    (tpfl.management.profiling): per-call recompile detection on the
+    wrapped jit seams (CompileObservatory), per-round wall-clock
+    attribution spans (RoundProfiler: train/dispatch/fold/gossip/
+    host_other), and the block_until_ready dispatch/compute split in
+    the learner. Off by default — disabled profiling is one attribute
+    read per instrumented site, adds ZERO device dispatches, and costs
+    no measurable rounds/sec (bench.py's profiling tier A/B); enabled
+    overhead is budgeted ≤5% like the telemetry tier. The always-cheap
+    registry side (compiled-cache hit/miss counters and size gauges,
+    HBM gauges) records regardless, per the PR-5 rule. Read at use
+    time, so it can be toggled between experiments."""
+
+    PROFILING_RECOMPILE_WARN: int = 8
+    """Distinct abstract argument signatures (shapes/dtypes/statics)
+    one wrapped program may accrete before the observatory flags a
+    RECOMPILE STORM (flight-ring event + log warning). Every distinct
+    signature is a fresh XLA compile — shape churn that defeats the
+    jit cache is the silent killer of steady-state throughput (the
+    vmap-width bucketing in simulation/batched_fit exists for exactly
+    this reason). Only read when PROFILING_ENABLED."""
+
+    PROFILING_TRACE_DIR: str = ""
+    """When set, federation runs wrap the experiment (StartLearning →
+    experiment finish) in a ``jax.profiler`` trace written here —
+    bench.py's opt-in ``--profile``, promoted to ANY run: the CLI's
+    ``tpfl experiment run --profile DIR`` sets this via the
+    ``TPFL_PROFILING_TRACE_DIR`` environment override. One process-wide
+    trace at a time (in-process federations share the profiler); view
+    with TensorBoard/xprof. Empty (default) disables."""
+
     # --- concurrency diagnostics ---
     LOCK_TRACING: bool = False
     """Opt-in runtime lock-order tracing (tpfl.concurrency): every lock
@@ -449,6 +482,13 @@ class Settings:
         cls.TELEMETRY_MAX_LABELSETS = 64
         cls.TELEMETRY_DUMP_DIR = ""
         cls.METRIC_MAX_POINTS = 4096
+        # Device-plane profiling off by default (profiling tests and
+        # the bench profiling tier toggle per-case); a low storm
+        # threshold would misfire on tests that legitimately churn
+        # shapes, so the class default rides.
+        cls.PROFILING_ENABLED = False
+        cls.PROFILING_RECOMPILE_WARN = 8
+        cls.PROFILING_TRACE_DIR = ""
 
     @classmethod
     def set_standalone_settings(cls) -> None:
@@ -507,6 +547,12 @@ class Settings:
         cls.TELEMETRY_MAX_LABELSETS = 64
         cls.TELEMETRY_DUMP_DIR = ""
         cls.METRIC_MAX_POINTS = 4096
+        # Profiling is an opt-in diagnostic here, like tracing: enable
+        # it (or pass the CLI's --profile) for a run you intend to
+        # read attribution/traces from.
+        cls.PROFILING_ENABLED = False
+        cls.PROFILING_RECOMPILE_WARN = 8
+        cls.PROFILING_TRACE_DIR = ""
 
     @classmethod
     def set_scale_settings(cls) -> None:
@@ -605,6 +651,13 @@ class Settings:
         cls.TELEMETRY_MAX_LABELSETS = 64
         cls.TELEMETRY_DUMP_DIR = ""
         cls.METRIC_MAX_POINTS = 4096
+        # 1000 in-process nodes: per-call signature probes and round
+        # spans share the GIL with the federation — profiling stays an
+        # explicit opt-in, and a higher storm threshold tolerates the
+        # wider legitimate shape variety (many partition sizes).
+        cls.PROFILING_ENABLED = False
+        cls.PROFILING_RECOMPILE_WARN = 16
+        cls.PROFILING_TRACE_DIR = ""
 
     @classmethod
     def snapshot(cls) -> dict[str, Any]:

@@ -18,6 +18,7 @@ batch together exactly.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable
 
 import jax
@@ -25,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpfl.learning.jax_learner import JaxLearner, TrainState, make_train_step
+from tpfl.management import profiling
 from tpfl.management.logger import logger
 from tpfl.settings import Settings
 
@@ -113,8 +115,15 @@ class BatchedFitProgram:
     ) -> tuple[Any, Any, Any]:
         key = (int(xs.shape[1]), int(epochs))
         fn = self._fns.get(key)
+        # Per-program shape cache: every distinct (n_batches, epochs)
+        # is a fresh XLA compile — the observatory's counters are how a
+        # shape-churning round schedule shows up before it hurts.
+        profiling.observatory.cache_event("batched_shape_fns", hit=fn is not None)
         if fn is None:
-            fn = self._fns[key] = self._build(epochs)
+            fn = self._fns[key] = profiling.observatory.wrap(
+                self._build(epochs),
+                f"batched_fit:{profiling.module_tag(self._module)}",
+            )
         return fn(
             stacked_params,
             stacked_aux,
@@ -149,6 +158,7 @@ def run_batched_fits(
     the learners of FAILED chunks only — already-trained chunks are
     final, so the caller must not re-fit them."""
     prog = _programs.get(signature)
+    profiling.observatory.cache_event("batched_programs", hit=prog is not None)
     if prog is None:
         prog = _programs[signature] = BatchedFitProgram(learners[0])
 
@@ -258,6 +268,11 @@ def _run_chunk(prog: BatchedFitProgram, learners: list[JaxLearner]) -> None:
     stacked_corr = _stack(corr_trees)
     stacked_anchor = _stack(anchor_trees)
 
+    # Round attribution: the chunk's dispatch gap and device compute
+    # are charged to EVERY participating node — each node's round
+    # blocked on this one program for its full duration.
+    prof = profiling.rounds.enabled()
+    t0 = time.monotonic() if prof else 0.0
     new_params, new_aux, losses = prog.run(
         stacked_params,
         stacked_aux,
@@ -269,6 +284,14 @@ def _run_chunk(prog: BatchedFitProgram, learners: list[JaxLearner]) -> None:
         np.stack(mask_l),
         epochs,
     )
+    if prof:
+        t1 = time.monotonic()
+        jax.block_until_ready(losses)
+        t2 = time.monotonic()
+        for j in jobs:
+            addr = j["learner"].get_addr()
+            profiling.rounds.add(addr, "dispatch", t1 - t0)
+            profiling.rounds.add(addr, "train", t2 - t1)
     losses = np.asarray(losses)
 
     params_per_node = _unstack(new_params, len(jobs))

@@ -28,7 +28,7 @@ from tpfl.communication.commands import (
 )
 from tpfl.experiment import Experiment
 from tpfl.learning.aggregators.aggregator import NoModelsToAggregateError
-from tpfl.management import tracing
+from tpfl.management import profiling, tracing
 from tpfl.management.logger import logger
 from tpfl.settings import Settings
 from tpfl.stages.stage import Stage, check_early_stop
@@ -65,6 +65,13 @@ class StartLearningStage(Stage):
         st.set_experiment(Experiment(node.exp_name, node.rounds))
         logger.experiment_started(node.addr, st.experiment)
         node.learner.set_epochs(node.epochs)
+        # Any run can produce a TPU trace, not just bench: when the
+        # experiment carries a profile dir (Settings.PROFILING_TRACE_DIR
+        # / the CLI's --profile), wrap it in a process-wide
+        # jax.profiler trace (idempotent — in-process peers share one
+        # profiler; stopped at experiment finish or Node.stop).
+        if st.experiment.profile_dir:
+            profiling.start_trace(st.experiment.profile_dir)
 
         # Wait for weights: released locally by set_start_learning (the
         # initiator), by an incoming InitModelCommand push, or by the
@@ -152,6 +159,10 @@ class VoteTrainSetStage(Stage):
         st = node.state
         if check_early_stop(node):
             return None
+        # Round-attribution window opens here (the first stage every
+        # participant — trainer or waiter — enters each round) and
+        # closes in RoundFinishedStage.
+        profiling.rounds.begin_round(node.addr, st.round)
         candidates = list(node.communication.get_neighbors()) + [node.addr]
 
         if Settings.ELECTION == "hash":
@@ -390,15 +401,19 @@ class TrainStage(Stage):
                 num_samples=num_samples,
             )
 
-        node.communication.gossip_weights(
-            early_stopping_fn=early_stop,
-            get_candidates_fn=candidates,
-            status_fn=lambda: sorted(
-                (k, tuple(sorted(v))) for k, v in st.get_models_aggregated().items()
-            ),
-            model_fn=model_for,
-            create_connection=True,
-        )
+        # "gossip" attribution: the partial-aggregate exchange and the
+        # round-result wait below are wire/peer time, not compute.
+        with profiling.rounds.span(node.addr, "gossip"):
+            node.communication.gossip_weights(
+                early_stopping_fn=early_stop,
+                get_candidates_fn=candidates,
+                status_fn=lambda: sorted(
+                    (k, tuple(sorted(v)))
+                    for k, v in st.get_models_aggregated().items()
+                ),
+                model_fn=model_for,
+                create_connection=True,
+            )
         if check_early_stop(node):
             node.aggregator.clear()
             return None
@@ -453,7 +468,8 @@ class TrainStage(Stage):
             stall = Settings.AGGREGATION_STALL
             return stall is not None and node.aggregator.stalled(stall)
 
-        status = _await_round_result(node, deadline, done_fn=coverage_done)
+        with profiling.rounds.span(node.addr, "gossip"):
+            status = _await_round_result(node, deadline, done_fn=coverage_done)
         if status == "early_stop":
             node.aggregator.clear()
             return None
@@ -564,7 +580,10 @@ class WaitAggregatedModelsStage(Stage):
     def execute(node: "Node") -> Optional[Type[Stage]]:
         st = node.state
         deadline = time.monotonic() + Settings.AGGREGATION_TIMEOUT
-        status = _await_round_result(node, deadline)
+        # Non-trainers spend their round waiting on the result to
+        # arrive over gossip — attribute it as such.
+        with profiling.rounds.span(node.addr, "gossip"):
+            status = _await_round_result(node, deadline)
         if status == "early_stop":
             return None
         if status == "timeout":
@@ -686,12 +705,14 @@ class GossipModelStage(Stage):
                 num_samples=num_samples,
             )
 
-        node.communication.gossip_weights(
-            early_stopping_fn=lambda: check_early_stop(node) or not candidates(),
-            get_candidates_fn=candidates,
-            status_fn=lambda: sorted(st.get_nei_status().items()),
-            model_fn=model_for,
-        )
+        with profiling.rounds.span(node.addr, "gossip"):
+            node.communication.gossip_weights(
+                early_stopping_fn=lambda: check_early_stop(node)
+                or not candidates(),
+                get_candidates_fn=candidates,
+                status_fn=lambda: sorted(st.get_nei_status().items()),
+                model_fn=model_for,
+            )
         return RoundFinishedStage
 
 
@@ -706,6 +727,10 @@ class RoundFinishedStage(Stage):
         if check_early_stop(node):
             return None
         node.aggregator.clear()
+        # Close the round-attribution window (opened at the vote
+        # stage): components + residual land in the registry and the
+        # flight ring before the round counter advances.
+        profiling.rounds.end_round(node.addr, st.round)
         # Keep train_set_votes: next-round votes may already be in it
         # (round-tagged entries are filtered at tally time).
         st.votes_ready_event.clear()
@@ -727,6 +752,9 @@ class RoundFinishedStage(Stage):
         # Experiment done: final eval, back to idle (reference :66-74).
         TrainStage._evaluate(node)
         logger.experiment_finished(node.addr)
+        # First finisher closes the process-wide profiler trace (no-op
+        # when none is active).
+        profiling.stop_trace()
         # Durable completion evidence: InitModelRequestCommand serves
         # final weights to stragglers only for experiments that actually
         # ran to completion here — status checks alone race the window
