@@ -798,6 +798,7 @@ def _telemetry_tier(extra: dict) -> None:
 TIERS = (
     "primary", "resnet", "attention", "transformer", "sim1000",
     "wire", "serde", "chaos", "analysis", "telemetry", "profiling",
+    "ledger",
 )
 
 
@@ -996,6 +997,175 @@ def _profiling_tier(extra: dict) -> None:
             }
     except Exception as e:
         extra["profiling_error"] = str(e)[:200]
+
+
+def _ledger_tier(extra: dict) -> None:
+    """Learning-plane observatory tier (management/ledger). Three
+    reports:
+
+    - extra.ledger_detection: seeded 10-node digits federation at 20%
+      sign-flip + 20% additive-noise adversaries — AnomalyScorer
+      precision/recall against the harness's known adversary map
+      (attacks/harness ground truth; acceptance: both >= 0.9) from the
+      deterministic detections() view.
+    - extra.ledger_determinism: two same-seed detection runs must
+      produce byte-identical flag sets (the detection surface is a
+      pure function of seed-deterministic features).
+    - extra.ledger_ab: rounds/sec with the ledger off vs on, at the
+      4-node fault-free scale every observability tier measures its
+      tax — the DISABLED path adds zero dispatches by construction;
+      the enabled tax must stay within the shared 5% budget.
+    """
+    from tpfl.management import ledger
+    from tpfl.settings import Settings
+
+    try:
+        snap = Settings.snapshot()
+        try:
+            from tpfl.attacks import (
+                additive_noise,
+                adversary_map,
+                run_seeded_experiment,
+                sign_flip,
+            )
+            from tpfl.management import ledger as _ledger
+            from tpfl.management.logger import logger as _logger
+
+            Settings.set_test_settings()
+            Settings.LOG_LEVEL = "ERROR"
+            _logger.set_level("ERROR")
+            seed = 4242
+            # Everyone trains every round (hash election with
+            # candidates <= K elects all): every contribution enters
+            # every open aggregator, so the ledger sees the full
+            # population each round.
+            Settings.ELECTION = "hash"
+
+            # 20% sign-flip + 20% additive-noise over 10 nodes (one
+            # attack instance per adversary — the noise counter is
+            # closure state).
+            def adversaries():
+                return {
+                    1: sign_flip(),
+                    4: sign_flip(),
+                    6: additive_noise(0.1, seed=6),
+                    8: additive_noise(0.1, seed=8),
+                }
+
+            def run_detect() -> "tuple[dict, str]":
+                Settings.LEDGER_ENABLED = True
+                Settings.TRAIN_SET_SIZE = 10
+                ledger.contrib.reset()
+                ledger.convergence.reset()
+                exp = run_seeded_experiment(
+                    seed, 10, 2,
+                    adversaries=adversaries(),
+                    samples_per_node=60,
+                    batch_size=20,
+                    timeout=240.0,
+                )
+                return ledger.contrib.detections(), exp
+
+            def run_ab(ledger_on: bool) -> float:
+                # Overhead arm at the scale every observability tier
+                # measures its tax (4 nodes, fault-free), with enough
+                # rounds that the fixed setup (start/connect/init
+                # diffusion) amortizes out of the rounds/sec figure.
+                Settings.LEDGER_ENABLED = ledger_on
+                Settings.TRAIN_SET_SIZE = 4
+                ledger.contrib.reset()
+                ledger.convergence.reset()
+                t0 = time.monotonic()
+                run_seeded_experiment(
+                    2626, 4, 6,
+                    samples_per_node=60,
+                    batch_size=20,
+                    timeout=240.0,
+                )
+                return time.monotonic() - t0
+
+            # Discarded warm runs pay the training programs' jit warmup
+            # AND the ledger's own stat-fn compiles, so the A/B
+            # measures steady-state tax, not one-time compilation. The
+            # arms INTERLEAVE and take best-of-3: round wall-clock at
+            # this scale is protocol-wait quantized (gossip ticks,
+            # heartbeat settles) with run noise far above the overhead
+            # being measured — min-of-runs with alternating arms
+            # cancels both the noise and any host drift.
+            det1, exp1 = run_detect()
+            det2, _ = run_detect()
+            run_ab(True)  # warm (ledger fns compile here)
+            off_times, on_times = [], []
+            for _ in range(3):
+                off_times.append(run_ab(False))
+                on_times.append(run_ab(True))
+            off_elapsed = min(off_times)
+            on_elapsed = min(on_times)
+            ab_rounds = 6
+
+            truth = set(adversary_map(exp1))
+            flagged = set(det1.get("flagged", {}))
+            tp = len(flagged & truth)
+            precision = tp / len(flagged) if flagged else 0.0
+            recall = tp / len(truth) if truth else 1.0
+            extra["ledger_detection"] = {
+                "seed": seed,
+                "nodes": 10,
+                "rounds": 2,
+                "adversaries": sorted(truth),
+                "flagged": {
+                    k: v["reasons"] for k, v in det1["flagged"].items()
+                },
+                "entries_scored": len(det1["entries"]),
+                "precision": round(precision, 4),
+                "recall": round(recall, 4),
+                "precision_ge_09": bool(precision >= 0.9),
+                "recall_ge_09": bool(recall >= 0.9),
+            }
+
+            def flag_surface(det: dict) -> str:
+                return json.dumps(
+                    [
+                        {
+                            "peer": e["peer"],
+                            "round": e["round"],
+                            "flagged": e["flagged"],
+                            "reasons": e["reasons"],
+                        }
+                        for e in det.get("entries", [])
+                    ],
+                    sort_keys=True,
+                )
+
+            extra["ledger_determinism"] = {
+                "byte_identical_flags": bool(
+                    flag_surface(det1) == flag_surface(det2)
+                ),
+                "entries_run1": len(det1.get("entries", [])),
+                "entries_run2": len(det2.get("entries", [])),
+            }
+
+            off_rps = ab_rounds / max(off_elapsed, 1e-9)
+            on_rps = ab_rounds / max(on_elapsed, 1e-9)
+            overhead = 1.0 - on_rps / max(off_rps, 1e-9)
+            extra["ledger_ab"] = {
+                "unledgered": {
+                    "elapsed_s": round(off_elapsed, 2),
+                    "rounds_per_s": round(off_rps, 3),
+                },
+                "ledgered": {
+                    "elapsed_s": round(on_elapsed, 2),
+                    "rounds_per_s": round(on_rps, 3),
+                },
+                "overhead_frac": round(overhead, 4),
+                "within_5pct_budget": bool(overhead < 0.05),
+            }
+        finally:
+            Settings.restore(snap)
+            ledger.contrib.reset()
+            ledger.convergence.reset()
+    except Exception as e:
+        extra["ledger_error"] = str(e)[:200]
 
 
 def main() -> None:
@@ -1682,6 +1852,13 @@ def main() -> None:
     # (extra.profiling_compile / profiling_ab / profiling_mfu).
     if "profiling" in tiers:
         _profiling_tier(extra)
+
+    # Ledger tier: seeded adversarial federation — anomaly-detection
+    # precision/recall vs the harness ground truth, same-seed flag
+    # determinism, ledger off/on overhead A/B
+    # (extra.ledger_detection / ledger_determinism / ledger_ab).
+    if "ledger" in tiers:
+        _ledger_tier(extra)
 
     # Only quantitative anchor in the reference: 2-round MNIST e2e must
     # fit in 240 s (node_test.py:105) -> 0.00833 rounds/s floor.

@@ -62,6 +62,7 @@ GUARDED_MODULES = (
     "tpfl/learning/bufferpool.py",
     "tpfl/management/metric_storage.py",
     "tpfl/management/logger.py",
+    "tpfl/management/ledger.py",
     "tpfl/management/node_monitor.py",
     "tpfl/management/profiling.py",
     "tpfl/management/telemetry.py",

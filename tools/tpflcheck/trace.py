@@ -34,8 +34,14 @@ from tools.tpflcheck.core import Violation, py_files, rel, repo_root
 
 #: Modules exempt from the lint: the management layer implements the
 #: telemetry/logging machinery itself (the flight recorder's wall
-#: anchor is the one sanctioned ``time.time()`` call).
+#: anchor is the one sanctioned ``time.time()`` call). NEW management
+#: modules are NOT automatically exempt — they consume the telemetry
+#: core like everyone else; the ledger (PR 7) is the first one linted.
 ALLOWED_PREFIX = "tpfl/management/"
+
+#: Management modules the lint DOES cover (consumers of the telemetry
+#: core, not implementors of it).
+LINTED_MANAGEMENT = ("tpfl/management/ledger.py",)
 
 _LOGGING_CALLS = {
     "debug", "info", "warning", "error", "critical", "exception",
@@ -65,7 +71,7 @@ def check_trace(repo: "pathlib.Path | None" = None) -> list[Violation]:
     out: list[Violation] = []
     for path in _lint_files(root):
         r = rel(root, path)
-        if r.startswith(ALLOWED_PREFIX):
+        if r.startswith(ALLOWED_PREFIX) and r not in LINTED_MANAGEMENT:
             continue
         if any(r.startswith(p) for p in EXEMPT_PREFIXES):
             continue

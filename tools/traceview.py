@@ -22,8 +22,16 @@ Run::
     python -m tools.traceview logs/flight-*.json
     python -m tools.traceview --summary dumps/
 
-Pure functions (:func:`build_timeline`, :func:`hop_path`) are the
-test/bench surface; the CLI is a thin formatter over them.
+``--ledger`` joins the learning-plane ledger's ``contrib`` / ``anomaly``
+events (``tpfl.management.ledger``, recorded into the same flight rings
+when ``Settings.LEDGER_ENABLED``) with the hop timelines by trace id:
+one command answers "which peer's update was this payload, what were
+its statistics, and was it flagged" — the update's network journey and
+its learning-plane verdict on one line.
+
+Pure functions (:func:`build_timeline`, :func:`hop_path`,
+:func:`ledger_report`) are the test/bench surface; the CLI is a thin
+formatter over them.
 """
 
 from __future__ import annotations
@@ -126,6 +134,85 @@ def trace_complete(chain: list[dict]) -> bool:
     return bool(consume_nodes - encode_nodes) or encode_nodes == consume_nodes
 
 
+def ledger_report(timeline: dict[str, list[dict]]) -> list[dict]:
+    """Join learning-plane ledger entries with their hop timelines.
+
+    For every ``contrib`` event (one accepted contribution's on-device
+    stats, recorded by ``tpfl.management.ledger``) returns::
+
+        {"trace", "peer", "observer", "round", "update_norm",
+         "cos_ref", "num_samples", "flagged", "reasons", "hops"}
+
+    ``hops`` is the payload's condensed hop chain (``encode@a →
+    send@a->b → ... → fold@b``) when the contribution's trace id is
+    reconstructable (tracing was on), else ``[]`` — a locally-fitted
+    contribution has no wire journey. ``anomaly`` events merge into
+    their contribution's row (reasons/z); untraceable ledger rows sort
+    last."""
+    rows: dict[tuple, dict] = {}
+    for trace, chain in timeline.items():
+        hops = [
+            e for e in chain if e.get("name") not in ("contrib", "anomaly")
+        ]
+        for e in chain:
+            if e.get("name") != "contrib":
+                continue
+            key = (str(e.get("node", "")), str(e.get("peer", "")),
+                   int(e.get("round", -1)))
+            rows[key] = {
+                "trace": trace,
+                "peer": str(e.get("peer", "")),
+                "observer": str(e.get("node", "")),
+                "round": int(e.get("round", -1)),
+                "update_norm": float(e.get("update_norm", 0.0)),
+                "cos_ref": float(e.get("cos_ref", 0.0)),
+                "num_samples": int(e.get("num_samples", 0)),
+                "flagged": bool(e.get("flagged", False)),
+                "reasons": [],
+                "hops": hop_path(hops) if trace else [],
+            }
+        for e in chain:
+            if e.get("name") != "anomaly":
+                continue
+            key = (str(e.get("node", "")), str(e.get("peer", "")),
+                   int(e.get("round", -1)))
+            row = rows.get(key)
+            if row is not None:
+                row["flagged"] = True
+                row["reasons"] = [
+                    r for r in str(e.get("reasons", "")).split(",") if r
+                ]
+                if "z_norm" in e:
+                    row["z_norm"] = float(e["z_norm"])
+    return sorted(
+        rows.values(),
+        key=lambda r: (r["round"], r["peer"], r["observer"]),
+    )
+
+
+def render_ledger(timeline: dict[str, list[dict]]) -> str:
+    rows = ledger_report(timeline)
+    if not rows:
+        return "no ledger entries (was Settings.LEDGER_ENABLED on?)"
+    lines = [
+        f"{len(rows)} ledger entries "
+        f"({sum(1 for r in rows if r['flagged'])} flagged)",
+        f"{'rnd':>3} {'peer':<18} {'observer':<18} {'|update|':>10} "
+        f"{'cos_ref':>8}  flags",
+    ]
+    for r in rows:
+        mark = ",".join(r["reasons"]) if r["reasons"] else (
+            "FLAGGED" if r["flagged"] else "-"
+        )
+        lines.append(
+            f"{r['round']:>3} {r['peer']:<18} {r['observer']:<18} "
+            f"{r['update_norm']:>10.4g} {r['cos_ref']:>8.3f}  {mark}"
+        )
+        if r["hops"]:
+            lines.append(f"      hops: {' -> '.join(r['hops'])}")
+    return "\n".join(lines)
+
+
 def summarize(timeline: dict[str, list[dict]]) -> dict[str, Any]:
     traced = {t: c for t, c in timeline.items() if t}
     complete = {t: c for t, c in traced.items() if trace_complete(c)}
@@ -182,12 +269,19 @@ def main(argv: "list[str] | None" = None) -> int:
         help="counts only (no per-trace chains)",
     )
     ap.add_argument(
+        "--ledger", action="store_true",
+        help="learning-plane view: contribution stats + anomaly flags "
+        "joined with each payload's hop chain by trace id",
+    )
+    ap.add_argument(
         "--limit", type=int, default=20,
         help="max traces to render (0 = all)",
     )
     args = ap.parse_args(argv)
     timeline = build_timeline(load(args.paths))
-    if args.summary:
+    if args.ledger:
+        print(render_ledger(timeline))
+    elif args.summary:
         print(json.dumps(summarize(timeline), indent=2))
     else:
         print(render(timeline, limit=args.limit))

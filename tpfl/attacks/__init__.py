@@ -26,6 +26,7 @@ from tpfl.attacks.attacks import (
     sign_flip,
 )
 from tpfl.attacks.harness import (
+    adversary_map,
     assert_tables_allclose,
     flatten_table,
     metric_table,
@@ -39,6 +40,7 @@ __all__ = [
     "AdversarialLearner",
     "make_adversary",
     "run_seeded_experiment",
+    "adversary_map",
     "metric_table",
     "flatten_table",
     "assert_tables_allclose",

@@ -15,6 +15,19 @@ from typing import Any, Callable, Optional
 import numpy as np
 
 from tpfl.attacks.attacks import AttackFn, make_adversary
+
+#: Ground-truth adversary registry: ``exp_name -> {addr: attack name}``
+#: recorded by :func:`run_seeded_experiment` for every adversarial run.
+#: This is what detection benchmarks (bench.py's ledger tier) score the
+#: AnomalyScorer's flags against — the harness KNOWS who poisoned,
+#: the ledger has to find them.
+_ADVERSARIES: dict[str, dict[str, str]] = {}
+
+
+def adversary_map(exp_name: str) -> dict[str, str]:
+    """``{node addr: attack name}`` for a harness-run experiment
+    (empty for fault-free runs / unknown experiments)."""
+    return dict(_ADVERSARIES.get(exp_name, {}))
 from tpfl.learning.dataset import RandomIIDPartitionStrategy, rendered_digits
 from tpfl.management.logger import logger
 from tpfl.models import create_model
@@ -104,6 +117,15 @@ def run_seeded_experiment(
         TopologyFactory.connect_nodes(matrix, nodes)
         wait_convergence(nodes, n - 1, only_direct=False, wait=30)
         exp_name = nodes[0].set_start_learning(rounds=rounds, epochs=epochs)
+        if adversaries:
+            # Ground truth for detection benchmarks: who actually
+            # poisons this experiment, by node address.
+            _ADVERSARIES[exp_name] = {
+                nodes[i].addr: str(
+                    getattr(fn, "name", getattr(fn, "__name__", "attack"))
+                )
+                for i, fn in adversaries.items()
+            }
         wait_to_finish(nodes, timeout=timeout)
         return exp_name
     finally:

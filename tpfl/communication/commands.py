@@ -392,7 +392,7 @@ class PartialModelCommand(NodeCommand):
         with tracing.maybe_span(
             "fold", st.addr, trace=trace, peer=source,
         ) as fold_span:
-            covered = self.node.aggregator.add_model(model)
+            covered = self.node.aggregator.add_model(model, trace=trace)
             fold_span.set(covered=len(covered))
         if covered:
             st.set_models_aggregated(st.addr, covered)

@@ -27,7 +27,7 @@ import jax.numpy as jnp
 
 from tpfl.concurrency import make_lock
 from tpfl.learning.model import TpflModel
-from tpfl.management import profiling, tracing
+from tpfl.management import ledger, profiling, tracing
 from tpfl.management.logger import logger
 from tpfl.settings import Settings
 
@@ -303,6 +303,10 @@ class Aggregator(ABC):
             self._removed_dead = set()
             self.version += 1
         self._finish_aggregation_event.set()
+        # Drop the ledger's round reference/accumulator (unconditional:
+        # a round opened under LEDGER_ENABLED must release its pinned
+        # params even if the knob was flipped off mid-round).
+        ledger.contrib.close_round(self.node_name)
 
     # --- model intake ---
 
@@ -316,24 +320,45 @@ class Aggregator(ABC):
             covered = {c for m in self._models for c in m.get_contributors()}
             return set(self._train_set) - covered
 
-    def add_model(self, model: TpflModel) -> list[str]:
+    def add_model(self, model: TpflModel, trace: str = "") -> list[str]:
         """Add a (possibly partially-aggregated) model; returns the list
         of contributors now covered, or [] if the model was rejected
-        (reference aggregator.py:113-175)."""
+        (reference aggregator.py:113-175).
+
+        ``trace``: the PR-5 trace id of the payload that carried this
+        contribution (PartialModelCommand threads it through) — the
+        ledger's join key between a contribution's statistics and its
+        hop timeline. "" for locally-fitted models."""
         try:
             contributors = model.get_contributors()
         except ValueError:
             logger.debug(self.node_name, "Dropping model with no contributors")
             return []
+        covered_out: "list[str] | None" = self._intake(model, contributors)
+        if covered_out is None:
+            return []
+        # Learning-plane ledger tap — the accepted contribution's fused
+        # on-device stats, recorded OUTSIDE _lock (telemetry never
+        # extends a protocol critical section) and before the caller
+        # proceeds; one attribute read when LEDGER_ENABLED is off.
+        if Settings.LEDGER_ENABLED:
+            ledger.contrib.record(self.node_name, model, trace=trace)
+        return covered_out
+
+    def _intake(
+        self, model: TpflModel, contributors: list[str]
+    ) -> "list[str] | None":
+        """The locked intake half of :meth:`add_model`: returns the
+        covered list on acceptance, None on rejection."""
         with self._lock:
             if self._finish_aggregation_event.is_set():
                 logger.debug(
                     self.node_name, "Dropping model: no aggregation in progress"
                 )
-                return []
+                return None
             if not self._train_set:
                 logger.debug(self.node_name, "Dropping model: no train set")
-                return []
+                return None
             extras = set(contributors) - set(self._train_set)
             if extras:
                 if extras <= self._removed_dead:
@@ -358,21 +383,21 @@ class Aggregator(ABC):
                         self.node_name,
                         f"Dropping model: contributors {contributors} not in train set",
                     )
-                    return []
+                    return None
             covered = {c for m in self._models for c in m.get_contributors()}
             if set(contributors).issubset(covered):
                 logger.debug(
                     self.node_name,
                     f"Dropping model: contributors {contributors} already covered",
                 )
-                return []
+                return None
             if covered & set(contributors):
                 # Overlap would double-count in a weighted mean.
                 logger.debug(
                     self.node_name,
                     f"Dropping model: contributors {contributors} overlap {covered}",
                 )
-                return []
+                return None
             self._models.append(model)
             # Eager on-arrival reduce (Settings.AGG_STREAM_EAGER): fold
             # the accepted contribution into the on-device accumulator

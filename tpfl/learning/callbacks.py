@@ -67,8 +67,17 @@ class TpflCallback(ABC):
         final_params: Any,
         num_steps: int,
         learning_rate: float,
+        avg_grad: Any = None,
     ) -> None:
-        """Called after the last step with start/end parameters."""
+        """Called after the last step with start/end parameters.
+
+        ``avg_grad``: the mean RAW mini-batch gradient over the fit
+        (pre-correction, optimizer-independent) — provided only when the
+        callback class sets ``wants_avg_grad = True`` (the learner then
+        builds the gradient-accumulating epoch program)."""
+
+    #: Subclasses that need ``avg_grad`` in ``on_fit_end`` set this.
+    wants_avg_grad: bool = False
 
 
 class ScaffoldCallback(TpflCallback):
@@ -82,6 +91,14 @@ class ScaffoldCallback(TpflCallback):
     """
 
     name = "scaffold"
+    # The variate update needs the TRUE average local gradient: the
+    # displacement estimate (x - y)/(K·lr) equals it only under vanilla
+    # SGD, and the default optimizer is SGD+momentum — whose ~1/(1-β)x
+    # inflated displacement made every c_i estimate ~10x too large and
+    # sent the corrected federation into divergence (the long-standing
+    # scaffold e2e failure). The learner accumulates raw per-step
+    # gradients in the jitted epoch when this is set.
+    wants_avg_grad = True
 
     def __init__(self) -> None:
         super().__init__()
@@ -108,16 +125,32 @@ class ScaffoldCallback(TpflCallback):
         final_params: Any,
         num_steps: int,
         learning_rate: float,
+        avg_grad: Any = None,
     ) -> None:
         c = self._info["global_c"]
         delta_y = jax.tree_util.tree_map(
             lambda y, x: y - x, final_params, initial_params
         )
-        # Option II: c_i+ = c_i - c + (x - y_i) / (K * lr)
-        scale = 1.0 / max(num_steps * learning_rate, 1e-12)
-        new_c_i = jax.tree_util.tree_map(
-            lambda ci, cg, dy: ci - cg - scale * dy, self.c_i, c, delta_y
-        )
+        if avg_grad is not None:
+            # Option II with exact accounting: under vanilla SGD,
+            # c_i+ = c_i - c + (x - y)/(K·lr) algebraically reduces to
+            # the average raw mini-batch gradient along the local
+            # trajectory — which the epoch program measured directly,
+            # so the update stays correct under ANY optimizer
+            # (momentum, adaptive) instead of assuming the displacement
+            # is lr-proportional.
+            new_c_i = jax.tree_util.tree_map(
+                lambda g, ci: g.astype(jnp.asarray(ci).dtype),
+                avg_grad,
+                self.c_i,
+            )
+        else:
+            # Displacement fallback (exact only for vanilla SGD):
+            # c_i+ = c_i - c + (x - y_i) / (K * lr)
+            scale = 1.0 / max(num_steps * learning_rate, 1e-12)
+            new_c_i = jax.tree_util.tree_map(
+                lambda ci, cg, dy: ci - cg - scale * dy, self.c_i, c, delta_y
+            )
         delta_c = jax.tree_util.tree_map(lambda n, o: n - o, new_c_i, self.c_i)
         self.c_i = new_c_i
         self._info["delta_y_i"] = delta_y

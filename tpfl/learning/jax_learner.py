@@ -37,7 +37,7 @@ from flax.training import train_state
 from tpfl.learning.dataset.tpfl_dataset import TpflDataset
 from tpfl.learning.learner import Learner
 from tpfl.learning.model import TpflModel
-from tpfl.management import profiling
+from tpfl.management import ledger, profiling
 from tpfl.management.logger import logger
 from tpfl.settings import Settings
 
@@ -74,7 +74,7 @@ def _shared_program(key: tuple, build: Callable[[], Callable]) -> Callable:
 
 
 def make_train_step(
-    module: Any, loss_fn: Callable, has_aux: bool
+    module: Any, loss_fn: Callable, has_aux: bool, with_grads: bool = False
 ) -> Callable:
     """THE local SGD step: forward, per-batch loss, grads + callback
     correction, optimizer update, mutable-collection (aux) threading.
@@ -88,6 +88,18 @@ def make_train_step(
     FedProx proximal pull ``mu * (w_t - w_global)``, which depends on
     the CURRENT params and so cannot ride the constant correction. Both
     are traced inputs — mu=0 shares the same compiled program.
+
+    ``with_grads`` (static, part of the program): the step additionally
+    returns the RAW mini-batch gradient (before correction/proximal
+    terms), ``(state, (loss, acc, grads))`` — what callbacks that need
+    the true local gradient trajectory (SCAFFOLD's control variates)
+    accumulate. Raw, not corrected: the control-variate update must
+    estimate the client's own gradient, and the optimizer's momentum
+    transform must not leak into it (the displacement-based estimate
+    ``(x - y)/(K·lr)`` equals the average gradient ONLY under vanilla
+    SGD; under SGD+momentum it is inflated ~1/(1-β)x and the variates
+    diverge — the root cause of the long-standing scaffold e2e
+    failure).
     """
 
     def apply(params, aux, x, train):
@@ -107,7 +119,7 @@ def make_train_step(
         (loss, (logits, new_aux)), grads = jax.value_and_grad(
             loss_of, has_aux=True
         )(state.params)
-        grads = jax.tree_util.tree_map(
+        corrected = jax.tree_util.tree_map(
             lambda g, c, p, a: (
                 g + c.astype(g.dtype) + (mu * (p - a)).astype(g.dtype)
             ),
@@ -116,9 +128,11 @@ def make_train_step(
             state.params,
             anchor,
         )
-        state = state.apply_gradients(grads=grads)
+        state = state.apply_gradients(grads=corrected)
         state = state.replace(aux_state=new_aux)
         acc = jnp.mean(jnp.argmax(logits, -1) == y)
+        if with_grads:
+            return state, (loss, acc, grads)
         return state, (loss, acc)
 
     return step
@@ -194,6 +208,10 @@ class JaxLearner(Learner):
         # One cache per learner: jitted fns close over the module; data
         # exports materialize Arrow -> numpy once, not once per round.
         self._train_epoch_fn: Optional[Callable] = None
+        # Whether the cached epoch program accumulates raw gradients —
+        # must track the callback set (a learner whose callbacks change
+        # between fits rebuilds, or the output arity would mismatch).
+        self._train_epoch_track = False
         self._eval_fn: Optional[Callable] = None
         self._train_batches: Optional[Any] = None
         self._eval_arrays: Optional[tuple] = None
@@ -214,25 +232,63 @@ class JaxLearner(Learner):
     def _has_aux(self) -> bool:
         return bool(self.get_model().aux_state)
 
+    def _track_grads(self) -> bool:
+        """True when any callback wants the true average local gradient
+        (``wants_avg_grad`` — SCAFFOLD): the epoch program then also
+        accumulates the raw per-step gradients. Part of the shared-
+        program cache key, so plain learners keep the cheaper program."""
+        return any(getattr(cb, "wants_avg_grad", False) for cb in self.callbacks)
+
     def _build_train_epoch(self) -> Callable:
         module = self._module()
         loss_fn = self._loss_fn
         has_aux = self._has_aux()
-        key = ("train_epoch", repr(module), loss_fn, has_aux)
+        track = self._track_grads()
+        key = ("train_epoch", repr(module), loss_fn, has_aux, track)
         # Observatory wrap rides the cache: one probe per ARCHITECTURE
         # (the module tag keeps different configs' signature sets — and
         # metric labels — apart), recompile detection on every call.
         return _shared_program(
             key,
             lambda: profiling.observatory.wrap(
-                self._make_train_epoch(module, loss_fn, has_aux),
+                self._make_train_epoch(module, loss_fn, has_aux, track),
                 f"train_epoch:{profiling.module_tag(module)}",
             ),
         )
 
     @staticmethod
-    def _make_train_epoch(module: Any, loss_fn: Callable, has_aux: bool) -> Callable:
-        step = make_train_step(module, loss_fn, has_aux)
+    def _make_train_epoch(
+        module: Any, loss_fn: Callable, has_aux: bool, track_grads: bool = False
+    ) -> Callable:
+        step = make_train_step(module, loss_fn, has_aux, with_grads=track_grads)
+
+        if track_grads:
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def train_epoch_g(state: TrainState, xs, ys, correction, anchor, mu):
+                gsum0 = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(
+                        p.shape, jnp.promote_types(p.dtype, jnp.float32)
+                    ),
+                    state.params,
+                )
+
+                def body(carry, b):
+                    s, gsum = carry
+                    s, (loss, acc, g) = step(
+                        s, b[0], b[1], correction, anchor, mu
+                    )
+                    gsum = jax.tree_util.tree_map(
+                        lambda a, x: a + x.astype(a.dtype), gsum, g
+                    )
+                    return (s, gsum), (loss, acc)
+
+                (state, gsum), (losses, accs) = jax.lax.scan(
+                    body, (state, gsum0), (xs, ys)
+                )
+                return state, jnp.mean(losses), jnp.mean(accs), gsum
+
+            return train_epoch_g
 
         @partial(jax.jit, donate_argnums=(0,))
         def train_epoch(state: TrainState, xs, ys, correction, anchor, mu):
@@ -354,15 +410,22 @@ class JaxLearner(Learner):
         final_aux: Any,
         n_steps: int,
         num_samples: int,
+        avg_grad: Any = None,
     ) -> None:
-        """Host-side post-fit lifecycle (counterpart of prepare_fit)."""
+        """Host-side post-fit lifecycle (counterpart of prepare_fit).
+
+        ``avg_grad``: mean raw mini-batch gradient over the fit's steps
+        (present only when a callback set ``wants_avg_grad`` — the epoch
+        program accumulated it), handed to ``on_fit_end`` so optimizer-
+        independent control-variate updates are possible."""
         model.set_parameters(final_params)
         if final_aux:
             model.aux_state = final_aux
         model.set_contribution([self._addr], num_samples)
         for cb in self.callbacks:
             cb.on_fit_end(
-                initial_params, final_params, n_steps, self.learning_rate
+                initial_params, final_params, n_steps, self.learning_rate,
+                avg_grad=avg_grad,
             )
         self.add_callback_info_to_model(model)
         # Record the fitted model: callers (pool submit_fit, TrainStage)
@@ -406,8 +469,10 @@ class JaxLearner(Learner):
     def fit(self) -> TpflModel:
         """Run ``self.epochs`` local epochs; one XLA program per epoch."""
         self._interrupt.clear()
-        if self._train_epoch_fn is None:
+        track = self._track_grads()
+        if self._train_epoch_fn is None or track != self._train_epoch_track:
             self._train_epoch_fn = self._build_train_epoch()
+            self._train_epoch_track = track
 
         model, initial_params, correction, mu, batches = self.prepare_fit()
         # Train on a copy: the state is donated to the compiled epoch,
@@ -426,6 +491,7 @@ class JaxLearner(Learner):
         )
         in_exp = self._in_experiment()
         n_steps = 0
+        gsum_total: Any = None
         # Read once per fit: the dispatch/compute split below adds a
         # block_until_ready the unprofiled path must not pay (and the
         # A/B comparison needs one consistent answer per fit).
@@ -436,7 +502,7 @@ class JaxLearner(Learner):
                 break
             xs, ys = batches.stacked(epoch=self._round_counter * 10_000 + epoch)
             t0 = time.monotonic() if prof else 0.0
-            state, loss, acc = self._train_epoch_fn(
+            out = self._train_epoch_fn(
                 state,
                 jnp.asarray(xs),
                 jnp.asarray(ys),
@@ -444,6 +510,15 @@ class JaxLearner(Learner):
                 initial_params,
                 jnp.float32(mu),
             )
+            if track:
+                state, loss, acc, gsum = out
+                gsum_total = (
+                    gsum
+                    if gsum_total is None
+                    else jax.tree_util.tree_map(jnp.add, gsum_total, gsum)
+                )
+            else:
+                state, loss, acc = out
             if prof:
                 # Proper block_until_ready discipline: the async call
                 # returning bounds the HOST dispatch gap; waiting for
@@ -459,6 +534,15 @@ class JaxLearner(Learner):
                 logger.log_metric(
                     self._addr, "train_loss", float(loss), step=epoch
                 )
+            # Learning-plane fit seam: the loss-trajectory monitor
+            # rides the float() the debug line below already forces —
+            # no added device sync, one attribute read when off.
+            if Settings.LEDGER_ENABLED:
+                ledger.convergence.observe_loss(
+                    self._addr,
+                    self._round_counter * 10_000 + epoch,
+                    float(loss),
+                )
             logger.debug(
                 self._addr,
                 f"epoch {epoch}: loss={float(loss):.4f} acc={float(acc):.4f}",
@@ -468,6 +552,10 @@ class JaxLearner(Learner):
         if n_steps == 0:
             return self.skip_fit(model)
 
+        avg_grad = None
+        if gsum_total is not None:
+            inv = jnp.float32(1.0 / max(n_steps, 1))
+            avg_grad = jax.tree_util.tree_map(lambda g: g * inv, gsum_total)
         self.finish_fit(
             model,
             initial_params,
@@ -475,6 +563,7 @@ class JaxLearner(Learner):
             state.aux_state,
             n_steps,
             batches.num_samples,
+            avg_grad=avg_grad,
         )
         return model
 

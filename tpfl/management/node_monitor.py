@@ -79,6 +79,7 @@ class NodeMonitor(threading.Thread):
         self._emit("net_in_bytes_per_s", (net.bytes_recv - last_recv) / dt)
         self._emit("net_out_bytes_per_s", (net.bytes_sent - last_sent) / dt)
         self._sample_tpu()
+        self._sample_ledger()
 
     def _sample_tpu(self) -> None:
         """TPU-native extension: HBM usage per local device, routed
@@ -93,5 +94,21 @@ class NodeMonitor(threading.Thread):
             for dev, in_use, peak in profiling.hbm.sample():
                 self._emit(f"hbm_bytes_in_use_dev{dev}", in_use)
                 self._emit(f"hbm_peak_bytes_dev{dev}", peak)
+        except Exception:
+            pass
+
+    def _sample_ledger(self) -> None:
+        """Learning-plane extension: this node's contribution-ledger
+        occupancy and flagged-anomaly count on the dashboard cadence
+        (the registry collector serves scrapes; this serves the
+        per-node web-dashboard push path). Host-side dict reads only."""
+        if not Settings.LEDGER_ENABLED:
+            return
+        try:
+            from tpfl.management import ledger
+
+            stats = ledger.contrib.stats_for(self._node)
+            self._emit("ledger_entries", float(stats["entries"]))
+            self._emit("ledger_flagged", float(stats["flagged"]))
         except Exception:
             pass

@@ -390,6 +390,52 @@ class Settings:
     trace at a time (in-process federations share the profiler); view
     with TensorBoard/xprof. Empty (default) disables."""
 
+    # --- learning-plane observatory (contribution ledger) ---
+    LEDGER_ENABLED: bool = False
+    """Master gate for the learning-plane observatory
+    (tpfl.management.ledger): per-contribution update statistics
+    (L2 norm, per-leaf norm profile, cosine vs the round-start
+    reference and vs the running update mean — one fused jitted
+    reduction per accepted contribution, O(1) memory), the bounded
+    per-node ContributionLedger ring, the ConvergenceMonitor
+    (global-model delta norm + loss-trajectory slope), and the
+    AnomalyScorer's sign-flip / norm-outlier detection. Off by
+    default — disabled, every tap is one attribute read and adds ZERO
+    device dispatches (bench.py's ledger tier off/on A/B is the
+    receipt); enabled overhead is budgeted <5% rounds/sec like
+    telemetry/profiling. Detection is observational: flags never
+    change aggregation results. Read at use time."""
+
+    LEDGER_RING: int = 1024
+    """Contribution-ledger capacity: the last N contribution records
+    retained PER NODE (the ring is also the anomaly scorer's
+    running-baseline window, so size it to cover several rounds of
+    the expected train set)."""
+
+    LEDGER_ANOMALY_Z: float = 6.0
+    """Robust z-score (vs the ledger window's median/1.4826·MAD) of a
+    contribution's update L2 norm at or above which it is flagged a
+    norm outlier (additive-noise signature: N(0, std) noise over d
+    parameters adds std·√d of update norm — tens of sigmas at the
+    attack-harness defaults, while honest updates cluster within a
+    few). Only applied once LEDGER_ANOMALY_MIN_N samples exist."""
+
+    LEDGER_ANOMALY_COS: float = 0.0
+    """Cosine similarity against the round-start reference at or below
+    which a contribution is flagged sign-flipped (a negated model sits
+    at ≈ -1; honest contributions at ≈ +1 — the margin is wide, and
+    the test needs no history, so round 0 already flags)."""
+
+    LEDGER_ANOMALY_MIN_N: int = 4
+    """Minimum single-contribution samples in the scorer's window
+    before the norm-outlier z-test applies (a median/MAD over fewer
+    points is noise; the cosine test is exempt — it needs no
+    baseline)."""
+
+    LEDGER_CONVERGENCE_WINDOW: int = 5
+    """Trailing window (rounds/fits) for the ConvergenceMonitor's
+    plateau/divergence tests and the loss-trajectory slope."""
+
     # --- concurrency diagnostics ---
     LOCK_TRACING: bool = False
     """Opt-in runtime lock-order tracing (tpfl.concurrency): every lock
@@ -489,6 +535,16 @@ class Settings:
         cls.PROFILING_ENABLED = False
         cls.PROFILING_RECOMPILE_WARN = 8
         cls.PROFILING_TRACE_DIR = ""
+        # Learning-plane ledger off by default (ledger tests and the
+        # bench ledger tier toggle per-case) — disabled taps add zero
+        # device dispatches, keeping seeded runs bit-identical to
+        # pre-ledger behavior.
+        cls.LEDGER_ENABLED = False
+        cls.LEDGER_RING = 1024
+        cls.LEDGER_ANOMALY_Z = 6.0
+        cls.LEDGER_ANOMALY_COS = 0.0
+        cls.LEDGER_ANOMALY_MIN_N = 4
+        cls.LEDGER_CONVERGENCE_WINDOW = 5
 
     @classmethod
     def set_standalone_settings(cls) -> None:
@@ -553,6 +609,15 @@ class Settings:
         cls.PROFILING_ENABLED = False
         cls.PROFILING_RECOMPILE_WARN = 8
         cls.PROFILING_TRACE_DIR = ""
+        # Ledger is an opt-in diagnostic here too — enable it for runs
+        # whose per-peer contribution stats / anomaly flags you intend
+        # to read (traceview --ledger).
+        cls.LEDGER_ENABLED = False
+        cls.LEDGER_RING = 1024
+        cls.LEDGER_ANOMALY_Z = 6.0
+        cls.LEDGER_ANOMALY_COS = 0.0
+        cls.LEDGER_ANOMALY_MIN_N = 4
+        cls.LEDGER_CONVERGENCE_WINDOW = 5
 
     @classmethod
     def set_scale_settings(cls) -> None:
@@ -658,6 +723,15 @@ class Settings:
         cls.PROFILING_ENABLED = False
         cls.PROFILING_RECOMPILE_WARN = 16
         cls.PROFILING_TRACE_DIR = ""
+        # Ledger off at 1000 in-process nodes for the same GIL/ring-
+        # memory reasons as tracing; the ring shrinks when enabled
+        # ad hoc (1000 rings x 1024 entries is real memory).
+        cls.LEDGER_ENABLED = False
+        cls.LEDGER_RING = 256
+        cls.LEDGER_ANOMALY_Z = 6.0
+        cls.LEDGER_ANOMALY_COS = 0.0
+        cls.LEDGER_ANOMALY_MIN_N = 4
+        cls.LEDGER_CONVERGENCE_WINDOW = 5
 
     @classmethod
     def snapshot(cls) -> dict[str, Any]:

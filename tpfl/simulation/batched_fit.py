@@ -26,7 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpfl.learning.jax_learner import JaxLearner, TrainState, make_train_step
-from tpfl.management import profiling
+from tpfl.management import ledger, profiling
 from tpfl.management.logger import logger
 from tpfl.settings import Settings
 
@@ -67,35 +67,57 @@ class BatchedFitProgram:
         self._opt = learner._tx
         self._loss_fn = learner._loss_fn
         self._has_aux = bool(learner.get_model().aux_state)
+        # Gradient-tracking programs (SCAFFOLD: a callback set
+        # wants_avg_grad) additionally accumulate the raw per-step
+        # gradients; job_signature includes the callback names, so
+        # tracking and plain jobs never share a program.
+        self._track = any(
+            getattr(cb, "wants_avg_grad", False) for cb in learner.callbacks
+        )
         self._fns: dict[tuple[int, int], Callable] = {}
 
     def _build(self, epochs: int) -> Callable:
         module, opt, loss_fn = self._module, self._opt, self._loss_fn
-        step = make_train_step(module, loss_fn, self._has_aux)
+        track = self._track
+        step = make_train_step(module, loss_fn, self._has_aux, with_grads=track)
 
         def local_fit(params, aux, correction, anchor, mu, xs, ys, bmask):
             state = TrainState.create(
                 apply_fn=None, params=params, tx=opt, aux_state=aux
             )
+            gsum0 = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(
+                    p.shape, jnp.promote_types(p.dtype, jnp.float32)
+                ),
+                state.params,
+            ) if track else jnp.float32(0)
 
-            def batch_step(st, batch):
+            def batch_step(carry, batch):
+                st, gsum = carry
                 x, y, m = batch
-                st2, (loss, _acc) = step(st, x, y, correction, anchor, mu)
+                if track:
+                    st2, (loss, _acc, g) = step(st, x, y, correction, anchor, mu)
+                    # Padding batches (m == 0) contribute zero gradient.
+                    gsum = jax.tree_util.tree_map(
+                        lambda a, gg: a + (gg * m).astype(a.dtype), gsum, g
+                    )
+                else:
+                    st2, (loss, _acc) = step(st, x, y, correction, anchor, mu)
                 # Masked (padding) batches are exact no-ops.
                 keep = m > 0
                 st = jax.tree_util.tree_map(
                     lambda old, new: jnp.where(keep, new, old), st, st2
                 )
-                return st, loss * m
+                return (st, gsum), loss * m
 
-            def epoch_step(st, _):
-                st, losses = jax.lax.scan(batch_step, st, (xs, ys, bmask))
-                return st, jnp.sum(losses) / jnp.maximum(jnp.sum(bmask), 1.0)
+            def epoch_step(carry, _):
+                carry, losses = jax.lax.scan(batch_step, carry, (xs, ys, bmask))
+                return carry, jnp.sum(losses) / jnp.maximum(jnp.sum(bmask), 1.0)
 
-            state, epoch_losses = jax.lax.scan(
-                epoch_step, state, None, length=epochs
+            (state, gsum), epoch_losses = jax.lax.scan(
+                epoch_step, (state, gsum0), None, length=epochs
             )
-            return state.params, state.aux_state, epoch_losses[-1]
+            return state.params, state.aux_state, epoch_losses[-1], gsum
 
         return jax.jit(
             jax.vmap(local_fit), donate_argnums=(0, 1)
@@ -112,7 +134,7 @@ class BatchedFitProgram:
         ys: np.ndarray,
         bmask: np.ndarray,
         epochs: int,
-    ) -> tuple[Any, Any, Any]:
+    ) -> tuple[Any, Any, Any, Any]:
         key = (int(xs.shape[1]), int(epochs))
         fn = self._fns.get(key)
         # Per-program shape cache: every distinct (n_batches, epochs)
@@ -273,7 +295,7 @@ def _run_chunk(prog: BatchedFitProgram, learners: list[JaxLearner]) -> None:
     # blocked on this one program for its full duration.
     prof = profiling.rounds.enabled()
     t0 = time.monotonic() if prof else 0.0
-    new_params, new_aux, losses = prog.run(
+    new_params, new_aux, losses, gsums = prog.run(
         stacked_params,
         stacked_aux,
         stacked_corr,
@@ -296,9 +318,18 @@ def _run_chunk(prog: BatchedFitProgram, learners: list[JaxLearner]) -> None:
 
     params_per_node = _unstack(new_params, len(jobs))
     aux_per_node = _unstack(new_aux, len(jobs))
+    gsum_per_node = _unstack(gsums, len(jobs)) if prog._track else None
     for i, j in enumerate(jobs):
         ln, model = j["learner"], j["model"]
         n_steps = j["xs"].shape[0] * epochs
+        avg_grad = None
+        if gsum_per_node is not None:
+            # The masked gsum summed only REAL batches; divide by the
+            # node's own step count, not the padded chunk max.
+            inv = jnp.float32(1.0 / max(n_steps, 1))
+            avg_grad = jax.tree_util.tree_map(
+                lambda g: g * inv, gsum_per_node[i]
+            )
         ln.finish_fit(
             model,
             j["initial"],
@@ -306,10 +337,19 @@ def _run_chunk(prog: BatchedFitProgram, learners: list[JaxLearner]) -> None:
             aux_per_node[i] if model.aux_state else None,
             n_steps,
             j["num_samples"],
+            avg_grad=avg_grad,
         )
         if ln._in_experiment():
             logger.log_metric(
                 ln.get_addr(), "train_loss", float(losses[i]), step=epochs - 1
+            )
+        # Same fit-seam loss tap as JaxLearner.fit (losses is already a
+        # host array — no added device sync).
+        if Settings.LEDGER_ENABLED:
+            ledger.convergence.observe_loss(
+                ln.get_addr(),
+                (ln._round_counter - 1) * 10_000 + epochs - 1,
+                float(losses[i]),
             )
         logger.debug(
             ln.get_addr(),

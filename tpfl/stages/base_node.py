@@ -28,7 +28,7 @@ from tpfl.communication.commands import (
 )
 from tpfl.experiment import Experiment
 from tpfl.learning.aggregators.aggregator import NoModelsToAggregateError
-from tpfl.management import profiling, tracing
+from tpfl.management import ledger, profiling, tracing
 from tpfl.management.logger import logger
 from tpfl.settings import Settings
 from tpfl.stages.stage import Stage, check_early_stop
@@ -291,6 +291,16 @@ class TrainStage(Stage):
     def execute(node: "Node") -> Optional[Type[Stage]]:
         st = node.state
         node.aggregator.set_nodes_to_aggregate(st.train_set)
+        # Learning-plane ledger: pin this round's ordinal and the
+        # round-start global parameters — the reference every accepted
+        # contribution's update stats are measured against (the model
+        # here is the adopted previous aggregate / init weights; the
+        # fit below trains on a copy, so the reference stays intact).
+        if Settings.LEDGER_ENABLED:
+            ledger.contrib.open_round(
+                node.addr, st.round,
+                node.learner.get_model().get_parameters(),
+            )
 
         # Replay partial models that arrived before this round opened
         # (stashed by PartialModelCommand; see NodeState.pending_partials).
@@ -731,6 +741,14 @@ class RoundFinishedStage(Stage):
         # stage): components + residual land in the registry and the
         # flight ring before the round counter advances.
         profiling.rounds.end_round(node.addr, st.round)
+        # Convergence monitor: every participant adopted the round
+        # result by now — one fused delta-norm dispatch per round when
+        # the ledger is on (divergence/plateau events + gauges).
+        if Settings.LEDGER_ENABLED:
+            ledger.convergence.observe_global(
+                node.addr, st.round,
+                node.learner.get_model().get_parameters(),
+            )
         # Keep train_set_votes: next-round votes may already be in it
         # (round-tagged entries are filtered at tally time).
         st.votes_ready_event.clear()
