@@ -551,6 +551,195 @@ def test_fedmedian_reservoir_is_bounded():
     np.testing.assert_allclose(np.asarray(exact.get_parameters()["w"]), 1.0)
 
 
+# --- streaming robust aggregators (bounded candidate buffers) ---
+
+
+def mk_bf16(value, n_samples, contributors):
+    params = {
+        "w": jnp.full((2, 2), float(value), jnp.bfloat16),
+        "b": jnp.full((2,), float(value), jnp.float32),
+    }
+    return TpflModel(
+        params=params, num_samples=n_samples, contributors=contributors
+    )
+
+
+def stream_fold(agg, models):
+    st = agg.acc_init(models[0])
+    for m in models:
+        st = agg.accumulate(st, m)
+    return agg.finalize(st)
+
+
+def test_krum_streaming_matches_batch_any_order():
+    """Explicit accumulate/finalize (any arrival order) must select the
+    same model as the all-at-once aggregate() fold."""
+    # Distinct spacings -> a unique argmin (mutual-nearest-neighbor
+    # ties would otherwise break by buffer order, not by score).
+    models = [mk_model(1.0, 1, ["a"]), mk_model(1.1, 2, ["b"]),
+              mk_model(1.3, 3, ["c"]), mk_model(1.6, 2, ["d"]),
+              mk_model(50.0, 1, ["evil"])]
+    batch = Krum("t", n_byzantine=1).aggregate(models)
+    agg = Krum("t", n_byzantine=1)
+    st = agg.acc_init(models[0])
+    for m in reversed(models):
+        st = agg.accumulate(st, m)
+    out = agg.finalize(st)
+    np.testing.assert_allclose(
+        np.asarray(batch.get_parameters()["w"]),
+        np.asarray(out.get_parameters()["w"]),
+    )
+    assert out.get_contributors() == ["a", "b", "c", "d", "evil"]
+    # Krum keeps the CHOSEN model's sample count (it returns one model).
+    assert out.get_num_samples() == batch.get_num_samples()
+
+
+def test_multikrum_streaming_weighted_mean_and_metadata():
+    """MultiKrum averages its selected models SAMPLE-WEIGHTED and keeps
+    the full input picture in metadata (all contributors, total
+    samples) — no per-model sample mass silently dropped."""
+    models = [mk_model(1.0, 1, ["a"]), mk_model(1.2, 3, ["b"]),
+              mk_model(5.0, 2, ["c"]), mk_model(-99.0, 1, ["evil"])]
+    agg = MultiKrum("t", n_byzantine=1, m=2)
+    out = agg.aggregate(models)
+    # metadata: every input is represented
+    assert out.get_contributors() == ["a", "b", "c", "evil"]
+    assert out.get_num_samples() == 7
+    # streaming == batch
+    out2 = stream_fold(MultiKrum("t", n_byzantine=1, m=2), models)
+    np.testing.assert_allclose(
+        np.asarray(out.get_parameters()["w"]),
+        np.asarray(out2.get_parameters()["w"]),
+        rtol=1e-6,
+    )
+    # Selection keeps the tight (a, b) cluster; the mean is weighted
+    # by num_samples: (1.0*1 + 1.2*3)/4 = 1.15, NOT the unweighted 1.1.
+    val = float(np.asarray(out.get_parameters()["w"])[0, 0])
+    assert val == pytest.approx(1.15, rel=1e-5)
+
+
+def test_trimmed_mean_streaming_matches_batch_bfloat16():
+    """Streaming-vs-batch equivalence with bfloat16 leaves: the
+    per-leaf reservoir preserves leaf dtypes until the fused
+    sort/mean."""
+    models = [mk_bf16(v, 1, [c]) for v, c in
+              [(0.0, "a"), (1.0, "b"), (2.0, "c"), (1000.0, "d")]]
+    agg = TrimmedMean("t", trim=1)
+    out_b = agg.aggregate(models)
+    out_s = stream_fold(TrimmedMean("t", trim=1), models)
+    for leaf in ("w", "b"):
+        np.testing.assert_allclose(
+            np.asarray(out_b.get_parameters()[leaf], np.float32),
+            np.asarray(out_s.get_parameters()[leaf], np.float32),
+        )
+    assert out_s.get_parameters()["w"].dtype == jnp.bfloat16
+
+
+def test_robust_single_model_edge():
+    """All three robust aggregators handle the single-model round
+    (timeout partials) identically in batch and streaming."""
+    for agg_f in (lambda: Krum("t"), lambda: MultiKrum("t"),
+                  lambda: TrimmedMean("t", trim=1)):
+        m = mk_model(3.0, 5, ["only"])
+        out_b = agg_f().aggregate([m])
+        out_s = stream_fold(agg_f(), [m])
+        np.testing.assert_allclose(
+            np.asarray(out_b.get_parameters()["w"]),
+            np.asarray(out_s.get_parameters()["w"]),
+        )
+        assert out_s.get_contributors() == ["only"]
+
+
+def test_robust_buffer_bounded():
+    """The candidate buffer is bounded at AGG_ROBUST_BUFFER: past the
+    cap, seeded reservoir replacement keeps memory flat and the result
+    finite."""
+    from tpfl.settings import Settings
+
+    Settings.AGG_ROBUST_BUFFER = 4
+    models = [mk_model(float(i), 1, [f"n{i}"]) for i in range(12)]
+    for agg in (Krum("t", n_byzantine=1), TrimmedMean("t", trim=1)):
+        st = agg.acc_init(models[0])
+        for m in models:
+            st = agg.accumulate(st, m)
+        assert len(st.extra["peers"]) == 4
+        assert len(st.extra["params"]) == 4
+        out = agg.finalize(st)
+        assert np.isfinite(np.asarray(out.get_parameters()["w"], np.float32)).all()
+        assert out.get_contributors() == sorted(f"n{i}" for i in range(12))
+
+
+def test_krum_precondition_validated_not_clamped():
+    """n < 2f+3 warns (Blanchard's requirement) instead of silently
+    clamping the neighborhood to 1."""
+    from tpfl.learning.aggregators.robust import krum_requirement_met
+
+    assert krum_requirement_met(5, 1)
+    assert not krum_requirement_met(4, 1)
+    assert not krum_requirement_met(10, 4)
+    warned = []
+    from tpfl.management.logger import logger as _logger
+
+    orig = _logger.warning
+    _logger.warning = lambda node, msg, *a, **k: warned.append(msg)
+    try:
+        agg = Krum("t", n_byzantine=4)
+        agg.aggregate([mk_model(float(i), 1, [f"n{i}"]) for i in range(5)])
+    finally:
+        _logger.warning = orig
+    assert any("under-provisioned" in m for m in warned)
+
+
+def test_trimmed_mean_no_trim_warns_and_surfaces():
+    """n <= 2*trim keeps every coordinate (no trimming possible): warn +
+    flight event instead of silence, and the effective trim lands in
+    the registry."""
+    from tpfl.management.logger import logger as _logger
+    from tpfl.management.telemetry import flight
+
+    warned = []
+    orig = _logger.warning
+    _logger.warning = lambda node, msg, *a, **k: warned.append(msg)
+    try:
+        flight.clear("t")
+        agg = TrimmedMean("t", trim=2)
+        out = agg.aggregate([mk_model(1.0, 1, ["a"]), mk_model(3.0, 1, ["b"])])
+    finally:
+        _logger.warning = orig
+    np.testing.assert_allclose(np.asarray(out.get_parameters()["w"]), 2.0)
+    assert any("cannot trim" in m for m in warned)
+    assert any(
+        e.get("name") == "no_trim" for e in flight.snapshot("t")
+    )
+
+
+def test_robust_quarantine_shrinks_candidates():
+    """A verdict landing AFTER a contribution was buffered still drops
+    it at finalize (the candidate-set shrink)."""
+    from tpfl.settings import Settings
+
+    class FakeEngine:
+        def quarantined(self):
+            return {"evil"}
+
+    Settings.QUARANTINE_ENABLED = True
+    try:
+        agg = TrimmedMean("t", trim=0)
+        agg.set_quarantine(FakeEngine())
+        models = [mk_model(1.0, 1, ["a"]), mk_model(3.0, 1, ["b"]),
+                  mk_model(500.0, 1, ["evil"])]
+        out = stream_fold(agg, models)
+        # evil was buffered but shrunk out before the mean.
+        np.testing.assert_allclose(np.asarray(out.get_parameters()["w"]), 2.0)
+
+        krum = Krum("t", n_byzantine=1)
+        krum.set_quarantine(FakeEngine())
+        out2 = stream_fold(krum, models)
+        assert float(np.asarray(out2.get_parameters()["w"])[0, 0]) < 4.0
+    finally:
+        Settings.QUARANTINE_ENABLED = False
+
+
 def test_eager_stream_fold_error_falls_back_to_batch():
     """A mid-round fold failure (e.g. SCAFFOLD info missing at arrival)
     must not poison the round: the eager stream dies and round close

@@ -92,6 +92,133 @@ def test_adversarial_learner_poisons_every_fit():
     ) < sum(float(np.abs(h + b).mean()) for h, b in zip(hp, before))
 
 
+# --- AttackPlan: declarative seeded attack schedules ---
+
+
+def test_attack_plan_from_dict_and_modes():
+    from tpfl.attacks import AttackPlan, AttackSpec
+
+    plan = AttackPlan.from_dict(
+        {
+            "seed": 7,
+            "peers": {
+                "a": {"attack": "sign_flip"},
+                "b": {"attack": "additive_noise", "std": 0.2,
+                      "mode": "ramp", "start": 2, "ramp_rounds": 2},
+                "1": {"attack": "sign_flip", "mode": "once", "start": 1},
+            },
+        }
+    )
+    assert plan.seed == 7
+    always = plan.spec_for("a")
+    assert [always.strength(r) for r in (0, 1, 5)] == [1.0, 1.0, 1.0]
+    ramp = plan.spec_for("b")
+    assert [ramp.strength(r) for r in (0, 1, 2, 3, 4)] == [
+        0.0, 0.0, 0.5, 1.0, 1.0,
+    ]
+    once = plan.spec_for("zz", index=1)  # positional key
+    assert [once.strength(r) for r in (0, 1, 2)] == [0.0, 1.0, 0.0]
+    # windowed always
+    spec = AttackSpec("sign_flip", start=1, end=3)
+    assert [spec.strength(r) for r in (0, 1, 2, 3)] == [0.0, 1.0, 1.0, 0.0]
+    with pytest.raises(ValueError):
+        AttackSpec("unknown_attack")
+    with pytest.raises(ValueError):
+        AttackSpec("sign_flip", mode="sometimes")
+
+
+def test_attack_plan_poison_deterministic():
+    """Noise derives from (plan seed, peer, round, leaf) — identical
+    across instances and call orders, distinct across peers/rounds."""
+    from tpfl.attacks import AttackPlan, AttackSpec
+
+    spec = AttackSpec("additive_noise", std=0.3)
+    params = _model_fn(0).get_parameters()
+    p1 = AttackPlan(seed=9).poison("peer-a", 2, spec, params)
+    p2 = AttackPlan(seed=9).poison("peer-a", 2, spec, params)
+    p_other_round = AttackPlan(seed=9).poison("peer-a", 3, spec, params)
+    p_other_peer = AttackPlan(seed=9).poison("peer-b", 2, spec, params)
+    import jax
+
+    l1 = [np.asarray(x) for x in jax.tree_util.tree_leaves(p1)]
+    l2 = [np.asarray(x) for x in jax.tree_util.tree_leaves(p2)]
+    lr = [np.asarray(x) for x in jax.tree_util.tree_leaves(p_other_round)]
+    lp = [np.asarray(x) for x in jax.tree_util.tree_leaves(p_other_peer)]
+    for a, b, r, p in zip(l1, l2, lr, lp):
+        np.testing.assert_array_equal(a, b)
+        assert not np.array_equal(a, r)
+        assert not np.array_equal(a, p)
+    # sign_flip at full strength is the exact reference negation; at
+    # ramp alpha=0.5 it passes through zero.
+    flip = AttackSpec("sign_flip")
+    f1 = AttackPlan(seed=9).poison("x", 0, flip, params)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(f1), jax.tree_util.tree_leaves(params)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), -np.asarray(b))
+
+
+def test_planned_adversary_fires_on_schedule():
+    """The learner wrapper consults the plan per fit ordinal: honest
+    before start, poisoned inside the window, honest after."""
+    from tpfl.attacks import AttackPlan, AttackSpec, PlannedAdversary
+    from tpfl.learning.jax_learner import JaxLearner
+
+    inner = JaxLearner(
+        model=_model_fn(0), data=_data_fn(0), addr="sched-adv", batch_size=50
+    )
+    plan = AttackPlan(
+        {"sched-adv": AttackSpec("sign_flip", mode="once", start=1)}, seed=3
+    )
+    adv = PlannedAdversary(inner, plan)
+    adv.set_epochs(1)
+    m0 = [np.asarray(x) for x in adv.fit().get_parameters_list()]
+    m1 = [np.asarray(x) for x in adv.fit().get_parameters_list()]
+    # fit 1 is the one-shot negation of an honest continuation; an
+    # honest fit from m0 stays near m0, the poisoned one lands near -m0
+    assert sum(float(np.abs(a + b).mean()) for a, b in zip(m1, m0)) < sum(
+        float(np.abs(a - b).mean()) for a, b in zip(m1, m0)
+    )
+    m2 = [np.asarray(x) for x in adv.fit().get_parameters_list()]
+    # fit 2: honest again (stays near m1, is not re-negated)
+    assert sum(float(np.abs(a - b).mean()) for a, b in zip(m2, m1)) < sum(
+        float(np.abs(a + b).mean()) for a, b in zip(m2, m1)
+    )
+
+
+def test_apply_chaos_composes_attack_and_fault_plans():
+    """One chaos spec: planned adversaries wrapped AND a fault injector
+    attached/armed on every node's protocol."""
+    from tpfl.attacks import AttackPlan, AttackSpec, apply_chaos
+    from tpfl.attacks.plan import PlannedAdversary
+    from tpfl.communication.faults import FaultPlan
+    from tpfl.learning.dataset import RandomIIDPartitionStrategy
+    from tpfl.node import Node
+
+    ds = _data_fn(0)
+    parts = ds.generate_partitions(2, RandomIIDPartitionStrategy, seed=0)
+    nodes = [
+        Node(_model_fn(0), parts[i], addr=f"chaos-n{i}") for i in range(2)
+    ]
+    try:
+        plan = AttackPlan({1: AttackSpec("sign_flip")}, seed=5)
+        fplan = FaultPlan.from_dict(
+            {"links": {"*->*": {"drop": 0.1}}}
+        )
+        truth, injector = apply_chaos(
+            nodes, attack_plan=plan, fault_plan=fplan, seed=5
+        )
+        assert truth == {"chaos-n1": "sign_flip"}
+        assert isinstance(nodes[1].learner, PlannedAdversary)
+        assert not isinstance(nodes[0].learner, PlannedAdversary)
+        for node in nodes:
+            assert node.communication._fault_injector is injector
+        assert injector.decide("chaos-n0", "chaos-n1") is not None
+    finally:
+        for node in nodes:
+            node.stop()
+
+
 # --- e2e: robust aggregators resist what breaks FedAvg ---
 
 

@@ -333,17 +333,31 @@ def test_traceview_ledger_report_joins_hops():
         {"kind": "event", "name": "anomaly", "node": "c", "trace": "",
          "t": 2.0, "peer": "adv", "round": 3,
          "reasons": "sign_flip,norm_outlier", "z_norm": 120.0},
+        # The quarantine engine's defense action for the same
+        # contribution, joined by (observer, peer, round) + trace id.
+        {"kind": "event", "name": "quarantine", "node": "c", "trace": "",
+         "t": 2.01, "peer": "adv", "round": 3,
+         "reasons": "sign_flip,norm_outlier"},
+        # A standalone readmit (its contrib entry already rotated out).
+        {"kind": "event", "name": "readmit", "node": "b", "trace": "tt1",
+         "t": 9.0, "peer": "adv", "round": 7, "reasons": ""},
     ]
     rows = ledger_report(build_timeline(entries))
-    assert len(rows) == 2
+    assert len(rows) == 3
     traced = next(r for r in rows if r["peer"] == "a")
     assert traced["hops"] == ["encode@a", "send@a->b", "decode@b"]
     assert traced["observer"] == "b" and not traced["flagged"]
-    adv = next(r for r in rows if r["peer"] == "adv")
+    adv = next(r for r in rows if r["peer"] == "adv" and r["round"] == 3)
     assert adv["flagged"] and adv["reasons"] == ["sign_flip", "norm_outlier"]
     assert adv["hops"] == []
+    assert adv["action"] == "quarantine"
+    readmit = next(
+        r for r in rows if r["peer"] == "adv" and r["round"] == 7
+    )
+    assert readmit["action"] == "readmit" and readmit["observer"] == "b"
     text = render_ledger(build_timeline(entries))
     assert "sign_flip" in text and "encode@a" in text
+    assert "[QUARANTINE]" in text and "[READMIT]" in text
 
 
 # --- end-to-end detection -------------------------------------------------

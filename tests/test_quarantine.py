@@ -1,0 +1,305 @@
+"""Active Byzantine defense tests: the QuarantineEngine at the
+aggregation intake (exclude-from-fold semantics, probation/readmission,
+fail-open), the deterministic replay verdict surface, and the
+detect→defend e2e against planned adversaries."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpfl.learning.aggregators import FedAvg
+from tpfl.learning.model import TpflModel
+from tpfl.management import ledger
+from tpfl.management.quarantine import (
+    QuarantineEngine,
+    quarantined_from_replay,
+    replay_decisions,
+)
+from tpfl.settings import Settings
+
+
+def mk_model(value, n_samples, contributors):
+    params = {
+        "w": jnp.full((3, 3), float(value), jnp.float32),
+        "b": jnp.full((3,), float(value), jnp.float32),
+    }
+    return TpflModel(
+        params=params, num_samples=n_samples, contributors=contributors
+    )
+
+
+REF = {
+    "w": jnp.full((3, 3), 1.0, jnp.float32),
+    "b": jnp.full((3,), 1.0, jnp.float32),
+}
+
+
+@pytest.fixture
+def defended():
+    """A FedAvg aggregator with a wired quarantine engine and a clean
+    ledger, defenses on."""
+    Settings.QUARANTINE_ENABLED = True
+    Settings.LEDGER_ENABLED = True
+    ledger.contrib.reset()
+    eng = QuarantineEngine("obs")
+    agg = FedAvg("obs")
+    agg.set_quarantine(eng)
+    yield agg, eng
+    agg.clear()
+    ledger.contrib.reset()
+    Settings.QUARANTINE_ENABLED = False
+    Settings.LEDGER_ENABLED = False
+
+
+def open_round(rnd):
+    ledger.contrib.open_round("obs", rnd, REF)
+
+
+def test_flagged_contribution_excluded_but_covered(defended):
+    """A sign-flipped contribution is accepted for COVERAGE (the round
+    closes) but its params never fold, and the peer is quarantined."""
+    agg, eng = defended
+    open_round(0)
+    agg.set_nodes_to_aggregate(["a", "b", "evil"])
+    assert agg.add_model(mk_model(1.1, 4, ["a"])) == ["a"]
+    assert agg.add_model(mk_model(1.3, 4, ["b"])) == ["a", "b"]
+    # Negated vs the shared reference: cos_ref ~ -1 -> flagged.
+    covered = agg.add_model(mk_model(-1.2, 4, ["evil"]))
+    assert covered == ["a", "b", "evil"]  # coverage complete
+    assert not agg.is_open()
+    out = agg.wait_and_get_aggregation(timeout=1)
+    # Mean of the two honest models only; evil rides as metadata.
+    np.testing.assert_allclose(np.asarray(out.get_parameters()["w"]), 1.2)
+    assert out.get_contributors() == ["a", "b", "evil"]
+    assert out.get_num_samples() == 8  # folded mass only
+    assert eng.quarantined() == {"evil"}
+    entry = [
+        e for e in ledger.contrib.entries("obs") if e["peer"] == "evil"
+    ][0]
+    assert entry["quarantined"] and "sign_flip" in entry["reasons"]
+
+
+def test_partial_carries_passenger_metadata(defended):
+    """get_model's multi-model partial folds only clean params and
+    lists the quarantined peer as a coverage passenger."""
+    agg, eng = defended
+    open_round(0)
+    agg.set_nodes_to_aggregate(["a", "b", "evil"])
+    agg.add_model(mk_model(2.0, 4, ["a"]))
+    agg.add_model(mk_model(-1.5, 4, ["evil"]))
+    agg.add_model(mk_model(4.0, 4, ["b"]))
+    partial = agg.get_model(except_nodes=[])
+    assert partial.get_contributors() == ["a", "b", "evil"]
+    assert partial.get_num_samples() == 8
+    np.testing.assert_allclose(
+        np.asarray(partial.get_parameters()["w"]), 3.0
+    )
+
+
+def test_mixture_of_only_quarantined_is_rejected(defended):
+    """A partial whose contributors are ALL quarantined is pure poison:
+    dropped outright (no coverage, no fold)."""
+    agg, eng = defended
+    open_round(0)
+    agg.set_nodes_to_aggregate(["a", "evil1", "evil2"])
+    agg.add_model(mk_model(-1.2, 4, ["evil1"]))
+    agg.add_model(mk_model(-1.4, 4, ["evil2"]))
+    assert eng.quarantined() == {"evil1", "evil2"}
+    assert agg.add_model(mk_model(-1.3, 8, ["evil1", "evil2"])) == []
+
+
+def test_probation_then_readmission(defended):
+    """A quarantined peer scoring clean re-enters the fold only after
+    QUARANTINE_PROBATION_ROUNDS have passed since its last flag."""
+    agg, eng = defended
+    Settings.QUARANTINE_PROBATION_ROUNDS = 1
+
+    def run_round(rnd, evil_value):
+        open_round(rnd)
+        agg.set_nodes_to_aggregate(["a", "evil"])
+        agg.add_model(mk_model(1.2, 4, ["a"]))
+        agg.add_model(mk_model(evil_value, 4, ["evil"]))
+        out = agg.wait_and_get_aggregation(timeout=1)
+        agg.clear()
+        return float(np.asarray(out.get_parameters()["w"])[0, 0])
+
+    assert run_round(0, -1.2) == pytest.approx(1.2)  # flagged, excluded
+    assert eng.quarantined() == {"evil"}
+    # Round 1: clean but still inside probation (1 - 0 <= 1): excluded.
+    assert run_round(1, 1.4) == pytest.approx(1.2)
+    assert eng.quarantined() == {"evil"}
+    # Round 2: clean and past probation (2 - 0 > 1): readmitted+folded.
+    assert run_round(2, 1.4) == pytest.approx(1.3)
+    assert eng.quarantined() == set()
+    actions = [a["action"] for a in eng.actions()]
+    assert actions == ["quarantine", "reject", "readmit"]
+
+
+def test_flag_during_probation_rearms_window(defended):
+    agg, eng = defended
+    Settings.QUARANTINE_PROBATION_ROUNDS = 1
+    # Isolate the cosine signal: with only two peers the identical
+    # honest updates make a degenerate (MAD-floored) norm window that
+    # would flag ANY distinct-but-clean value as an outlier.
+    Settings.LEDGER_ANOMALY_MIN_N = 99
+
+    def run_round(rnd, evil_value):
+        open_round(rnd)
+        agg.set_nodes_to_aggregate(["a", "evil"])
+        agg.add_model(mk_model(1.2, 4, ["a"]))
+        agg.add_model(mk_model(evil_value, 4, ["evil"]))
+        agg.wait_and_get_aggregation(timeout=1)
+        agg.clear()
+
+    run_round(0, -1.2)  # quarantine @ 0
+    run_round(1, -1.2)  # flagged again: window re-arms from round 1
+    run_round(2, 1.4)  # clean but 2 - 1 <= 1: still excluded
+    assert eng.quarantined() == {"evil"}
+    run_round(3, 1.4)  # 3 - 1 > 1: readmitted
+    assert eng.quarantined() == set()
+
+
+def test_norm_outlier_uses_prior_round_window(defended):
+    """The additive-noise z-test scores against PRIOR rounds' clean
+    entries (deterministic — this round's arrival order never matters):
+    a huge-norm contribution passes in round 0 (no baseline) and is
+    flagged in round 1."""
+    agg, eng = defended
+    Settings.LEDGER_ANOMALY_MIN_N = 4
+
+    def run_round(rnd, noisy_value):
+        open_round(rnd)
+        peers = ["a", "b", "c", "d", "noisy"]
+        agg.set_nodes_to_aggregate(peers)
+        for i, p in enumerate(peers[:-1]):
+            agg.add_model(mk_model(1.1 + 0.01 * i, 4, [p]))
+        agg.add_model(mk_model(noisy_value, 4, ["noisy"]))
+        agg.wait_and_get_aggregation(timeout=1)
+        agg.clear()
+
+    run_round(0, 90.0)  # norm ~ tens of sigmas, but no prior window
+    assert eng.quarantined() == set()
+    run_round(1, 90.0)  # window = round 0's clean entries -> flagged
+    assert eng.quarantined() == {"noisy"}
+    rec = eng.record_for("noisy")
+    assert "norm_outlier" in rec["reasons"]
+
+
+def test_all_flagged_fails_open(defended):
+    """If verdicts exclude EVERY contribution, the close folds them all
+    anyway (loud, counted) — the defense can not brick the round."""
+    agg, eng = defended
+    open_round(0)
+    agg.set_nodes_to_aggregate(["evil1", "evil2"])
+    agg.add_model(mk_model(-1.0, 4, ["evil1"]))
+    agg.add_model(mk_model(-3.0, 4, ["evil2"]))
+    out = agg.wait_and_get_aggregation(timeout=1)
+    np.testing.assert_allclose(np.asarray(out.get_parameters()["w"]), -2.0)
+
+
+def test_disabled_defense_is_inert(defended):
+    """QUARANTINE_ENABLED=False: poisoned contributions fold exactly as
+    before the defense existed (byte-equal aggregate)."""
+    agg, eng = defended
+    Settings.QUARANTINE_ENABLED = False
+    open_round(0)
+    agg.set_nodes_to_aggregate(["a", "evil"])
+    agg.add_model(mk_model(2.0, 4, ["a"]))
+    agg.add_model(mk_model(-2.0, 4, ["evil"]))
+    out = agg.wait_and_get_aggregation(timeout=1)
+    np.testing.assert_allclose(np.asarray(out.get_parameters()["w"]), 0.0)
+    assert eng.quarantined() == set()
+
+
+def test_replay_decisions_matches_live_and_is_stable(defended):
+    """The deterministic replay over the ledger's deduped detections
+    reproduces the live engine's action sequence, and two replays are
+    byte-identical."""
+    import json
+
+    agg, eng = defended
+    Settings.QUARANTINE_PROBATION_ROUNDS = 1
+    for rnd, evil in [(0, -1.2), (1, 1.4), (2, 1.4)]:
+        open_round(rnd)
+        agg.set_nodes_to_aggregate(["a", "evil"])
+        agg.add_model(mk_model(1.2, 4, ["a"]))
+        agg.add_model(mk_model(evil, 4, ["evil"]))
+        agg.wait_and_get_aggregation(timeout=1)
+        agg.clear()
+    replay = replay_decisions()
+    assert [a["action"] for a in replay if a["peer"] == "evil"] == [
+        "quarantine", "reject", "readmit",
+    ]
+    assert json.dumps(replay, sort_keys=True) == json.dumps(
+        replay_decisions(), sort_keys=True
+    )
+    assert quarantined_from_replay(replay) == set()
+    live = [a for a in eng.actions() if a["peer"] == "evil"]
+    assert [a["action"] for a in live] == [
+        a["action"] for a in replay if a["peer"] == "evil"
+    ]
+
+
+def test_repush_scores_once(defended):
+    """Gossip re-pushes of the same (peer, round) contribution dedup in
+    the ledger: one scored entry, one quarantine action."""
+    agg, eng = defended
+    open_round(0)
+    agg.set_nodes_to_aggregate(["a", "evil"])
+    m = mk_model(-1.2, 4, ["evil"])
+    agg.add_model(m)
+    agg.add_model(m)  # duplicate push (rejected by intake, but assessed)
+    agg.add_model(mk_model(-1.2, 4, ["evil"]))  # identical re-encode
+    entries = [
+        e for e in ledger.contrib.entries("obs") if e["peer"] == "evil"
+    ]
+    assert len(entries) == 1
+    assert [a["action"] for a in eng.actions()] == ["quarantine"]
+
+
+@pytest.mark.chaos
+def test_quarantine_e2e_excludes_planned_adversary():
+    """Seeded 4-node federation with one scheduled sign-flip adversary:
+    exactly the planned peer is quarantined on every observer, the
+    rounds close (coverage via passengers), and a once-mode attacker is
+    re-admitted after probation."""
+    from tpfl.attacks import (
+        AttackPlan,
+        AttackSpec,
+        adversary_map,
+        run_seeded_experiment,
+    )
+    from tpfl.management import quarantine
+
+    snap = Settings.snapshot()
+    try:
+        Settings.LOG_LEVEL = "ERROR"
+        Settings.ELECTION = "hash"
+        Settings.TRAIN_SET_SIZE = 4
+        Settings.QUARANTINE_ENABLED = True
+        Settings.LEDGER_ENABLED = True
+        Settings.QUARANTINE_PROBATION_ROUNDS = 1
+        ledger.contrib.reset()
+        plan = AttackPlan(
+            {1: AttackSpec("sign_flip", mode="once", start=0)}, seed=31
+        )
+        exp = run_seeded_experiment(
+            31, 4, 4, attack_plan=plan,
+            samples_per_node=60, batch_size=20, timeout=240.0,
+        )
+        truth = set(adversary_map(exp))
+        assert truth == {"seed31-n1"}
+        replay = replay_decisions()
+        flagged = {a["peer"] for a in replay if a["action"] == "quarantine"}
+        assert flagged == truth  # exactly the planned adversary
+        # once-attack: flagged round 0, clean after, readmitted once
+        # probation (1 round) passed.
+        peer_actions = [
+            a["action"] for a in replay if a["peer"] == "seed31-n1"
+        ]
+        assert peer_actions[0] == "quarantine"
+        assert "readmit" in peer_actions
+        assert quarantined_from_replay(replay) == set()
+    finally:
+        Settings.restore(snap)
+        ledger.contrib.reset()

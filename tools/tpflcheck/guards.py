@@ -67,7 +67,11 @@ GUARDED_MODULES = (
     "tpfl/management/profiling.py",
     "tpfl/management/telemetry.py",
     "tpfl/management/tracing.py",
+    "tpfl/management/quarantine.py",
     "tpfl/learning/aggregators/aggregator.py",
+    "tpfl/learning/aggregators/robust.py",
+    "tpfl/attacks/attacks.py",
+    "tpfl/attacks/plan.py",
 )
 
 _GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)(\s+writes)?")

@@ -41,7 +41,10 @@ ALLOWED_PREFIX = "tpfl/management/"
 
 #: Management modules the lint DOES cover (consumers of the telemetry
 #: core, not implementors of it).
-LINTED_MANAGEMENT = ("tpfl/management/ledger.py",)
+LINTED_MANAGEMENT = (
+    "tpfl/management/ledger.py",
+    "tpfl/management/quarantine.py",
+)
 
 _LOGGING_CALLS = {
     "debug", "info", "warning", "error", "critical", "exception",

@@ -147,13 +147,15 @@ def ledger_report(timeline: dict[str, list[dict]]) -> list[dict]:
     send@a->b → ... → fold@b``) when the contribution's trace id is
     reconstructable (tracing was on), else ``[]`` — a locally-fitted
     contribution has no wire journey. ``anomaly`` events merge into
-    their contribution's row (reasons/z); untraceable ledger rows sort
-    last."""
+    their contribution's row (reasons/z), and the quarantine engine's
+    ``quarantine`` / ``readmit`` actions (tpfl.management.quarantine)
+    merge as the row's ``action`` — the payload's network journey, its
+    learning-plane verdict, AND the defense decision it triggered on
+    one line; untraceable ledger rows sort last."""
+    ledger_names = ("contrib", "anomaly", "quarantine", "readmit")
     rows: dict[tuple, dict] = {}
     for trace, chain in timeline.items():
-        hops = [
-            e for e in chain if e.get("name") not in ("contrib", "anomaly")
-        ]
+        hops = [e for e in chain if e.get("name") not in ledger_names]
         for e in chain:
             if e.get("name") != "contrib":
                 continue
@@ -172,18 +174,46 @@ def ledger_report(timeline: dict[str, list[dict]]) -> list[dict]:
                 "hops": hop_path(hops) if trace else [],
             }
         for e in chain:
-            if e.get("name") != "anomaly":
+            name = e.get("name")
+            if name not in ("anomaly", "quarantine", "readmit"):
                 continue
             key = (str(e.get("node", "")), str(e.get("peer", "")),
                    int(e.get("round", -1)))
             row = rows.get(key)
-            if row is not None:
+            if row is None:
+                if name == "anomaly":
+                    continue
+                # Quarantine actions can outlive their triggering
+                # contribution's ring entry: surface them standalone.
+                row = rows[key] = {
+                    "trace": trace,
+                    "peer": str(e.get("peer", "")),
+                    "observer": str(e.get("node", "")),
+                    "round": int(e.get("round", -1)),
+                    "update_norm": 0.0,
+                    "cos_ref": 0.0,
+                    "num_samples": 0,
+                    "flagged": False,
+                    "reasons": [],
+                    "hops": hop_path(hops) if trace else [],
+                }
+            if name == "anomaly":
                 row["flagged"] = True
                 row["reasons"] = [
                     r for r in str(e.get("reasons", "")).split(",") if r
                 ]
                 if "z_norm" in e:
                     row["z_norm"] = float(e["z_norm"])
+            else:
+                row["action"] = name
+                if name == "quarantine":
+                    row["flagged"] = True
+                    if not row["reasons"]:
+                        row["reasons"] = [
+                            r
+                            for r in str(e.get("reasons", "")).split(",")
+                            if r
+                        ]
     return sorted(
         rows.values(),
         key=lambda r: (r["round"], r["peer"], r["observer"]),
@@ -204,6 +234,8 @@ def render_ledger(timeline: dict[str, list[dict]]) -> str:
         mark = ",".join(r["reasons"]) if r["reasons"] else (
             "FLAGGED" if r["flagged"] else "-"
         )
+        if r.get("action"):
+            mark = f"{mark} [{r['action'].upper()}]"
         lines.append(
             f"{r['round']:>3} {r['peer']:<18} {r['observer']:<18} "
             f"{r['update_norm']:>10.4g} {r['cos_ref']:>8.3f}  {mark}"

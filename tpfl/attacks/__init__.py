@@ -12,7 +12,14 @@ measures the effect on federation metrics. Here attacks are first-class:
   that re-applies its attack to every local fit before the update
   enters aggregation (the threat model Krum/TrimmedMean defend
   against; the robust aggregators live in
-  ``tpfl.learning.aggregators.robust``).
+  ``tpfl.learning.aggregators.robust``);
+- :class:`AttackPlan` / :class:`PlannedAdversary` /
+  :func:`apply_chaos` (``tpfl.attacks.plan``) — declarative seeded
+  per-peer attack SCHEDULES (which peers, which rounds, which attack,
+  ramp/once/always), the adversarial mirror of
+  :class:`~tpfl.communication.faults.FaultPlan`, composable with a
+  fault plan into one chaos spec and carrying the ground-truth
+  ``adversary_map`` detection benchmarks score against.
 
 See :mod:`tpfl.attacks.harness` for the seeded reproducibility harness
 (``exp_SAVE3.txt:282-332``).
@@ -32,6 +39,13 @@ from tpfl.attacks.harness import (
     metric_table,
     run_seeded_experiment,
 )
+from tpfl.attacks.plan import (
+    AttackPlan,
+    AttackSpec,
+    PlannedAdversary,
+    apply_attack_plan,
+    apply_chaos,
+)
 
 __all__ = [
     "sign_flip",
@@ -39,6 +53,11 @@ __all__ = [
     "poison_model",
     "AdversarialLearner",
     "make_adversary",
+    "AttackPlan",
+    "AttackSpec",
+    "PlannedAdversary",
+    "apply_attack_plan",
+    "apply_chaos",
     "run_seeded_experiment",
     "adversary_map",
     "metric_table",

@@ -48,6 +48,8 @@ def run_seeded_experiment(
     *,
     epochs: int = 1,
     adversaries: Optional[dict[int, AttackFn]] = None,
+    attack_plan: Optional[Any] = None,
+    fault_plan: Optional[Any] = None,
     aggregator_factory: Optional[Callable[[], Any]] = None,
     topology: TopologyType = TopologyType.STAR,
     model_fn: Optional[Callable[[int], Any]] = None,
@@ -61,9 +63,14 @@ def run_seeded_experiment(
 
     ``adversaries`` maps node index -> attack (persistent, applied to
     every fit — see :class:`tpfl.attacks.AdversarialLearner`).
-    ``model_fn(seed)`` / ``data_fn(seed)`` override the default MLP /
-    rendered-digits pair. Reference: star topology, seeded settings
-    (exp_SAVE3.txt:116-156).
+    ``attack_plan`` is the declarative alternative
+    (:class:`tpfl.attacks.plan.AttackPlan`: which peers, which rounds,
+    which attack, ramp/once/always — seeded, schedule-aware), and
+    ``fault_plan`` (:class:`tpfl.communication.faults.FaultPlan`)
+    composes network chaos into the same run; both plans' ground truth
+    lands in :func:`adversary_map`. ``model_fn(seed)`` / ``data_fn
+    (seed)`` override the default MLP / rendered-digits pair.
+    Reference: star topology, seeded settings (exp_SAVE3.txt:116-156).
     """
     prev_seed = Settings.SEED
     Settings.SEED = seed
@@ -110,22 +117,35 @@ def run_seeded_experiment(
             )
             if adversaries and i in adversaries:
                 make_adversary(node, adversaries[i])
-            node.start()
             nodes.append(node)
+
+        # Declarative chaos: scheduled adversaries + network faults in
+        # one spec, wired BEFORE start (learners wrap unstarted nodes).
+        plan_truth: dict[str, str] = {}
+        if attack_plan is not None or fault_plan is not None:
+            from tpfl.attacks.plan import apply_chaos
+
+            plan_truth, _ = apply_chaos(
+                nodes, attack_plan=attack_plan, fault_plan=fault_plan,
+                seed=seed,
+            )
+        for node in nodes:
+            node.start()
 
         matrix = TopologyFactory.generate_matrix(topology, n)
         TopologyFactory.connect_nodes(matrix, nodes)
         wait_convergence(nodes, n - 1, only_direct=False, wait=30)
         exp_name = nodes[0].set_start_learning(rounds=rounds, epochs=epochs)
-        if adversaries:
+        if adversaries or plan_truth:
             # Ground truth for detection benchmarks: who actually
-            # poisons this experiment, by node address.
-            _ADVERSARIES[exp_name] = {
-                nodes[i].addr: str(
+            # poisons this experiment, by node address — derived from
+            # the plan when one is given.
+            truth = dict(plan_truth)
+            for i, fn in (adversaries or {}).items():
+                truth[nodes[i].addr] = str(
                     getattr(fn, "name", getattr(fn, "__name__", "attack"))
                 )
-                for i, fn in adversaries.items()
-            }
+            _ADVERSARIES[exp_name] = truth
         wait_to_finish(nodes, timeout=timeout)
         return exp_name
     finally:

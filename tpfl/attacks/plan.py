@@ -1,0 +1,252 @@
+"""Declarative, seeded per-peer attack schedules — the adversarial
+mirror of :class:`tpfl.communication.faults.FaultPlan`.
+
+PR 2 made *network* chaos declarative and reproducible (drop / delay /
+corrupt / crash / partition, per-link RNG streams); this module does
+the same for *learning-plane* adversaries. An :class:`AttackPlan` names
+which peers attack, with which attack, over which rounds, at what
+intensity (``always`` / ``once`` / ``ramp``), and every noise draw
+derives from ``(seed, peer, round, leaf)`` — two same-(seed, plan) runs
+poison byte-identically regardless of thread interleaving (the closure
+counter in :func:`tpfl.attacks.attacks.additive_noise` could not
+guarantee that when an instance was shared).
+
+Composition: :func:`apply_chaos` installs an attack plan AND a fault
+plan on one federation in one call — malicious peers coexist with
+drops, crashes and partitions in a single chaos spec, the way
+pfl-research treats adversarial simulation as a benchmarked tier and
+BlazeFL demands the run stay deterministic. The plan is also the
+**ground truth**: :meth:`AttackPlan.adversary_map` is what detection /
+quarantine benchmarks score against (the plan KNOWS who poisons; the
+defense has to find them).
+
+Schema (:meth:`AttackPlan.from_dict`)::
+
+    {"seed": 7,
+     "peers": {"node-3": {"attack": "sign_flip"},
+               "node-6": {"attack": "additive_noise", "std": 0.1,
+                           "mode": "ramp", "start": 2, "ramp_rounds": 3},
+               "1":      {"attack": "sign_flip", "mode": "once",
+                           "start": 0}}}
+
+Peer keys are node addresses, or integer indices resolved against the
+node list at :func:`apply_attack_plan` time (the harness's seeded
+addresses are positional).
+"""
+
+from __future__ import annotations
+
+import zlib
+from dataclasses import dataclass
+from typing import Any, Iterable, Optional
+
+from tpfl.attacks.attacks import AdversarialLearner
+from tpfl.settings import Settings
+
+ATTACKS = ("sign_flip", "additive_noise")
+MODES = ("always", "once", "ramp")
+
+
+@dataclass
+class AttackSpec:
+    """One peer's attack schedule.
+
+    ``mode``: ``"always"`` poisons every fit in ``[start, end)``;
+    ``"once"`` poisons exactly the ``start`` fit; ``"ramp"`` scales the
+    attack linearly from ``1/ramp_rounds`` at ``start`` to full
+    strength over ``ramp_rounds`` fits (then holds until ``end``).
+    ``std`` of None reads ``Settings.ATTACK_NOISE_STD`` at poison time.
+    """
+
+    attack: str = "sign_flip"
+    mode: str = "always"
+    start: int = 0
+    end: Optional[int] = None
+    std: Optional[float] = None
+    ramp_rounds: int = 1
+
+    def __post_init__(self) -> None:
+        if self.attack not in ATTACKS:
+            raise ValueError(
+                f"Unknown attack {self.attack!r}: expected one of {ATTACKS}"
+            )
+        if self.mode not in MODES:
+            raise ValueError(
+                f"Unknown mode {self.mode!r}: expected one of {MODES}"
+            )
+
+    def strength(self, round: int) -> float:
+        """Attack intensity in [0, 1] for one fit ordinal; 0 = honest."""
+        if round < self.start:
+            return 0.0
+        if self.mode == "once":
+            return 1.0 if round == self.start else 0.0
+        if self.end is not None and round >= self.end:
+            return 0.0
+        if self.mode == "ramp":
+            ramp = max(1, int(self.ramp_rounds))
+            return min(1.0, (round - self.start + 1) / ramp)
+        return 1.0
+
+    @property
+    def name(self) -> str:
+        if self.attack == "additive_noise":
+            std = self.std if self.std is not None else Settings.ATTACK_NOISE_STD
+            return f"additive_noise(std={std})"
+        return self.attack
+
+
+class AttackPlan:
+    """Seeded per-peer attack schedules, keyed by address (or node
+    index — resolved when the plan is applied)."""
+
+    def __init__(
+        self,
+        peers: "dict[Any, AttackSpec] | None" = None,
+        seed: Optional[int] = None,
+    ) -> None:
+        # unguarded: plan config — built once, read-only after
+        # construction (the PlannedAdversary wrappers only read).
+        self.peers: dict[Any, AttackSpec] = dict(peers or {})
+        self._seed = seed
+
+    @property
+    def seed(self) -> int:
+        """Plan seed (falls back to Settings.SEED at use time, the
+        FaultInjector convention)."""
+        return (Settings.SEED or 0) if self._seed is None else self._seed
+
+    @classmethod
+    def from_dict(cls, spec: dict[str, Any]) -> "AttackPlan":
+        peers: dict[Any, AttackSpec] = {}
+        for key, s in (spec.get("peers") or {}).items():
+            peers[key] = AttackSpec(**s)
+        return cls(peers=peers, seed=spec.get("seed"))
+
+    def spec_for(self, addr: str, index: Optional[int] = None) -> Optional[AttackSpec]:
+        """The spec targeting ``addr`` (exact address key first, then
+        the positional index as int or string)."""
+        hit = self.peers.get(addr)
+        if hit is None and index is not None:
+            hit = self.peers.get(index)
+            if hit is None:
+                hit = self.peers.get(str(index))
+        return hit
+
+    # --- the poison itself (pure function of (seed, peer, round)) ---
+
+    def poison(
+        self, addr: str, round: int, spec: AttackSpec, params: Any
+    ) -> Any:
+        """Apply ``spec`` at full-strength-scaled ``strength(round)`` to
+        a parameter pytree. Deterministic per (plan seed, addr, round,
+        leaf index): no shared counters, no interleaving sensitivity."""
+        alpha = spec.strength(round)
+        if alpha <= 0.0:
+            return params
+        import jax
+        import jax.numpy as jnp
+
+        if spec.attack == "sign_flip":
+            # alpha=1 is the reference negation; a ramped flip walks
+            # the parameters through zero toward the mirror image.
+            scale = 1.0 - 2.0 * alpha
+            return jax.tree_util.tree_map(lambda x: scale * x, params)
+        std = spec.std if spec.std is not None else Settings.ATTACK_NOISE_STD
+        std = float(std) * alpha
+        base = jax.random.PRNGKey(self.seed)
+        base = jax.random.fold_in(base, zlib.crc32(addr.encode()) & 0x7FFFFFFF)
+        base = jax.random.fold_in(base, int(round))
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        out = []
+        for i, leaf in enumerate(leaves):
+            k = jax.random.fold_in(base, i)
+            noise = jax.random.normal(k, jnp.shape(leaf), jnp.float32)
+            out.append(leaf + (std * noise).astype(jnp.asarray(leaf).dtype))
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def adversary_map(
+        self, addrs: "Iterable[str] | None" = None
+    ) -> dict[str, str]:
+        """Ground truth ``{addr: attack name}``. With ``addrs`` (the
+        federation's node addresses in index order), integer/string
+        index keys resolve to their address; without, only
+        address-keyed peers are returned."""
+        resolved: dict[str, str] = {}
+        addr_list = list(addrs) if addrs is not None else []
+        for i, addr in enumerate(addr_list):
+            spec = self.spec_for(addr, i)
+            if spec is not None:
+                resolved[addr] = spec.name
+        if addrs is None:
+            for key, spec in self.peers.items():
+                if isinstance(key, str) and not key.isdigit():
+                    resolved[key] = spec.name
+        return resolved
+
+
+class PlannedAdversary(AdversarialLearner):
+    """Round-aware model-poisoning adversary driven by an
+    :class:`AttackPlan`: every ``fit()`` trains honestly, then applies
+    the plan's scheduled attack (if any) for this peer at this fit
+    ordinal. Pure delegation otherwise (see AdversarialLearner)."""
+
+    def __init__(self, inner: Any, plan: AttackPlan, index: Optional[int] = None) -> None:
+        super().__init__(inner, attack=lambda p: p)
+        self._plan = plan
+        self._index = index
+        # Fit ordinal = round counter: stages call fit() exactly once
+        # per round on the learning thread.
+        # unguarded: only the learning thread calls fit().
+        self._round = 0
+
+    def fit(self):
+        model = self._inner.fit()
+        rnd, self._round = self._round, self._round + 1
+        addr = self.get_addr()
+        spec = self._plan.spec_for(addr, self._index)
+        if spec is not None and spec.strength(rnd) > 0.0:
+            model.set_parameters(
+                self._plan.poison(addr, rnd, spec, model.get_parameters())
+            )
+        self._last_fit_model = model
+        return model
+
+
+def apply_attack_plan(nodes: "list[Any]", plan: AttackPlan) -> dict[str, str]:
+    """Wrap every planned peer's learner in a
+    :class:`PlannedAdversary` (nodes must not be started yet). Returns
+    the resolved ground-truth adversary map."""
+    truth: dict[str, str] = {}
+    for i, node in enumerate(nodes):
+        spec = plan.spec_for(node.addr, i)
+        if spec is None:
+            continue
+        node.learner = PlannedAdversary(node.learner, plan, index=i)
+        truth[node.addr] = spec.name
+    return truth
+
+
+def apply_chaos(
+    nodes: "list[Any]",
+    attack_plan: Optional[AttackPlan] = None,
+    fault_plan: Optional[Any] = None,
+    seed: Optional[int] = None,
+) -> "tuple[dict[str, str], Any]":
+    """One chaos spec for one federation: malicious peers (attack plan)
+    alongside drops/crashes/partitions (fault plan). Returns
+    ``(adversary_map, fault_injector)`` — the injector (or None) is
+    attached to every node's protocol and its schedule clock started.
+    """
+    truth: dict[str, str] = {}
+    if attack_plan is not None:
+        truth = apply_attack_plan(nodes, attack_plan)
+    injector = None
+    if fault_plan is not None:
+        from tpfl.communication.faults import FaultInjector
+
+        injector = FaultInjector(fault_plan, seed=seed)
+        for node in nodes:
+            injector.attach(node.communication)
+        injector.start()
+    return truth, injector

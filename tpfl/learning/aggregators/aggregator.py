@@ -43,10 +43,12 @@ def stack_models(models: list[TpflModel]) -> tuple[Any, jnp.ndarray]:
 
     Memory note: the stacked tree materializes N x model at once, which
     is why the mean-style aggregators (FedAvg/FedProx/SCAFFOLD) moved to
-    the O(1)-peak streaming accumulate/finalize API below. This helper
-    remains for the aggregators whose math genuinely needs every
-    contribution at once — Krum's pairwise distances, trimmed mean's
-    per-coordinate sort, FedMedian's (bounded) reservoir."""
+    the O(1)-peak streaming accumulate/finalize API below, and the
+    robust family (Krum/MultiKrum/TrimmedMean) to bounded per-round
+    candidate buffers (``Settings.AGG_ROBUST_BUFFER``). This helper
+    remains for math that genuinely wants an explicit model list side
+    by side — FedMedian's finalize stacks its bounded reservoir the
+    same way."""
     trees = [m.get_parameters() for m in models]
     stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
     weights = jnp.asarray([float(m.get_num_samples()) for m in models])
@@ -110,6 +112,16 @@ class Aggregator(ABC):
         # bundling one of them re-admits it (see add_model).
         # guarded-by: _lock
         self._removed_dead: set[str] = set()
+        # Active-defense seam (tpfl.management.quarantine): wired once
+        # by Node before any thread starts; None on bare aggregators.
+        # unguarded: written once at construction time, read-only after.
+        self._quarantine: Any = None
+        # Models accepted for COVERAGE but excluded from the fold by a
+        # quarantine verdict, keyed by object identity (they stay in
+        # _models so contributor bookkeeping — covered sets, gossip
+        # coverage spreading — is unchanged; only the math skips them).
+        # guarded-by: _lock
+        self._excluded: dict[int, str] = {}
         self._lock = make_lock("Aggregator._lock")
         self._finish_aggregation_event = threading.Event()
         self._finish_aggregation_event.set()
@@ -136,10 +148,11 @@ class Aggregator(ABC):
 
         Streaming aggregators (``SUPPORTS_STREAMING``) get this for
         free as a sequential accumulate/finalize fold: peak memory is
-        O(1) model — the donated accumulator plus the one contribution
-        being folded — instead of the O(N x model) ``stack_models``
-        materialization. Non-streaming aggregators (Krum, trimmed
-        mean) override with their all-at-once math."""
+        O(1) model (mean family — donated running accumulator) or
+        O(buffer) (robust family — bounded candidate reservoir)
+        instead of the O(N x model) ``stack_models`` materialization.
+        Non-streaming aggregators override with their all-at-once
+        math."""
         if not models:
             raise ValueError("No models to aggregate")
         if not self.SUPPORTS_STREAMING:
@@ -171,6 +184,21 @@ class Aggregator(ABC):
     def finalize(self, state: AggStream) -> TpflModel:
         raise NotImplementedError
 
+    def set_quarantine(self, engine: Any) -> None:
+        """Attach the node's QuarantineEngine (tpfl.management
+        .quarantine). Called once by Node construction, before any
+        protocol thread exists; verdicts gate the fold only while
+        ``Settings.QUARANTINE_ENABLED``."""
+        self._quarantine = engine
+
+    def quarantined_peers(self) -> set[str]:
+        """Peers the attached engine currently excludes (empty when no
+        engine / defense off) — the candidate-set shrink hook the
+        robust aggregators consult at finalize."""
+        if self._quarantine is None or not Settings.QUARANTINE_ENABLED:
+            return set()
+        return self._quarantine.quarantined()
+
     def get_required_callbacks(self) -> list[str]:
         return list(self.REQUIRED_CALLBACKS)
 
@@ -196,6 +224,7 @@ class Aggregator(ABC):
             self._stream = None
             self._stream_dead = False
             self._removed_dead = set()
+            self._excluded = {}
             self.version += 1
             self._last_intake = time.monotonic()
             # Clear under the lock: a model arriving between the train-set
@@ -301,6 +330,7 @@ class Aggregator(ABC):
             self._stream = None
             self._stream_dead = False
             self._removed_dead = set()
+            self._excluded = {}
             self.version += 1
         self._finish_aggregation_event.set()
         # Drop the ledger's round reference/accumulator (unconditional:
@@ -334,22 +364,52 @@ class Aggregator(ABC):
         except ValueError:
             logger.debug(self.node_name, "Dropping model with no contributors")
             return []
-        covered_out: "list[str] | None" = self._intake(model, contributors)
+        # Active-defense verdict BEFORE the fold (outside _lock — the
+        # live scoring dispatches a jitted reduction; the engine/ledger
+        # hold only their own leaf locks). An excluded contribution is
+        # still accepted for COVERAGE (rejecting it would stall every
+        # peer on the missing contributor until AGGREGATION_TIMEOUT) —
+        # _intake parks it fold-exempt. One attribute read when
+        # QUARANTINE_ENABLED is off. Gossip re-pushes of the same
+        # contribution dedup inside the ledger, so the verdict is
+        # computed once per (peer, round).
+        verdict: "dict | None" = None
+        if Settings.QUARANTINE_ENABLED and self._quarantine is not None:
+            verdict = self._quarantine.assess(model, contributors, trace=trace)
+        if verdict is not None and verdict["exclude"] and not verdict["recorded"]:
+            # All-quarantined mixture: pure poison, nothing coverage
+            # needs from it (each member's own contribution covers it).
+            logger.debug(
+                self.node_name,
+                f"Dropping quarantined mixture from {contributors}",
+            )
+            return []
+        covered_out: "list[str] | None" = self._intake(
+            model,
+            contributors,
+            exclude=bool(verdict is not None and verdict["exclude"]),
+        )
         if covered_out is None:
             return []
         # Learning-plane ledger tap — the accepted contribution's fused
         # on-device stats, recorded OUTSIDE _lock (telemetry never
         # extends a protocol critical section) and before the caller
-        # proceeds; one attribute read when LEDGER_ENABLED is off.
-        if Settings.LEDGER_ENABLED:
+        # proceeds; one attribute read when LEDGER_ENABLED is off. The
+        # quarantine assessment above already recorded+scored single
+        # contributions eagerly — don't double-record those.
+        if Settings.LEDGER_ENABLED and not (
+            verdict is not None and verdict["recorded"]
+        ):
             ledger.contrib.record(self.node_name, model, trace=trace)
         return covered_out
 
     def _intake(
-        self, model: TpflModel, contributors: list[str]
+        self, model: TpflModel, contributors: list[str], exclude: bool = False
     ) -> "list[str] | None":
         """The locked intake half of :meth:`add_model`: returns the
-        covered list on acceptance, None on rejection."""
+        covered list on acceptance, None on rejection. ``exclude``
+        (quarantine verdict) accepts the model for coverage bookkeeping
+        but keeps its params out of every fold."""
         with self._lock:
             if self._finish_aggregation_event.is_set():
                 logger.debug(
@@ -399,6 +459,25 @@ class Aggregator(ABC):
                 )
                 return None
             self._models.append(model)
+            if exclude:
+                # Quarantined: coverage-only passenger. Params never
+                # fold; the eager stream counts it "offered" (like a
+                # skipped zero-sample fit) so the close-time
+                # offered-vs-held consistency check still trusts the
+                # stream.
+                self._excluded[id(model)] = ",".join(sorted(contributors))
+                if (
+                    self.SUPPORTS_STREAMING
+                    and Settings.AGG_STREAM_EAGER
+                    and not self._stream_dead
+                ):
+                    try:
+                        if self._stream is None:
+                            self._stream = self.acc_init(model)
+                        self._stream.offered += 1
+                    except Exception:
+                        self._stream = None
+                        self._stream_dead = True
             # Eager on-arrival reduce (Settings.AGG_STREAM_EAGER): fold
             # the accepted contribution into the on-device accumulator
             # NOW, so the round-close aggregation is one finalize
@@ -409,7 +488,8 @@ class Aggregator(ABC):
             # batch fold over the held models (which reports the error
             # through the normal aggregate() path).
             if (
-                self.SUPPORTS_STREAMING
+                not exclude
+                and self.SUPPORTS_STREAMING
                 and Settings.AGG_STREAM_EAGER
                 and not self._stream_dead
             ):
@@ -471,6 +551,7 @@ class Aggregator(ABC):
                 self._models, key=lambda m: tuple(sorted(m.get_contributors()))
             )
             stream, self._stream = self._stream, None
+            excluded_ids = dict(self._excluded)
             # Snapshot for the timeout log below: _train_set is
             # _lock-guarded state and remove_dead_nodes/add_model keep
             # mutating it after this block releases the lock.
@@ -487,6 +568,26 @@ class Aggregator(ABC):
             raise NoModelsToAggregateError(
                 f"({self.node_name}) No models to aggregate"
             )
+        # Quarantine verdicts: coverage-only passengers never fold. If
+        # the verdicts emptied the fold entirely (catastrophic false
+        # positive — every contribution flagged), FAIL OPEN with a loud
+        # warning: a defense must degrade to the undefended aggregate,
+        # never brick the round. Deterministic either way (verdicts are
+        # pure functions of seed-deterministic state).
+        fold_models = [m for m in models if id(m) not in excluded_ids]
+        if not fold_models:
+            if excluded_ids:
+                logger.warning(
+                    self.node_name,
+                    "Quarantine excluded EVERY held contribution "
+                    f"({sorted(excluded_ids.values())}); failing open to "
+                    "the undefended fold",
+                )
+                logger.metrics.counter(
+                    "tpfl_quarantine_fail_open_total",
+                    labels={"node": self.node_name},
+                )
+            fold_models = models
         t_close = time.monotonic()
         try:
             with tracing.maybe_span(
@@ -498,11 +599,16 @@ class Aggregator(ABC):
                     and stream.offered == len(models)
                     and stream.count
                 ):
-                    # Every held model went through the eager fold: the
-                    # round's reduce already happened on-device as
+                    # Every held model went through the eager fold (or
+                    # was counted as an offered-and-skipped passenger):
+                    # the round's reduce already happened on-device as
                     # partials arrived — close is a single finalize.
-                    return self.finalize(stream)
-                return self.aggregate(models)
+                    out = self.finalize(stream)
+                else:
+                    out = self.aggregate(fold_models)
+                return self._with_passengers(
+                    out, models, excluded_ids, folded_all=fold_models is models
+                )
         finally:
             # Round-close aggregation wall time, eager or batch — the
             # aggregator timing the registry always carries even when
@@ -516,10 +622,49 @@ class Aggregator(ABC):
                 self.node_name, "fold", time.monotonic() - t_close
             )
 
+    @staticmethod
+    def _with_passengers(
+        out: TpflModel,
+        models: list[TpflModel],
+        excluded_ids: "dict[int, str]",
+        folded_all: bool = False,
+    ) -> TpflModel:
+        """Extend an aggregate's CONTRIBUTOR metadata with the
+        quarantine-excluded passengers among ``models``. Coverage
+        bookkeeping (covered sets, gossip exchange, round close) runs
+        on contributor lists, so the excluded peers must stay visible
+        there — but their params never folded, and ``num_samples``
+        stays the folded total so the payload's weight in any
+        downstream weighted mean is exactly the honest mass it
+        carries."""
+        if folded_all or not excluded_ids:
+            return out
+        passengers = {
+            c
+            for m in models
+            if id(m) in excluded_ids
+            for c in m.get_contributors()
+        } - set(out.get_contributors())
+        if not passengers:
+            return out
+        return out.build_copy(
+            params=out.get_parameters(),
+            contributors=sorted(set(out.get_contributors()) | passengers),
+            num_samples=out.get_num_samples(),
+        )
+
     def get_model(self, except_nodes: list[str] | None = None) -> TpflModel | None:
         """Partial aggregate of held models excluding contributions from
         ``except_nodes`` — what we gossip to a peer that already has those
-        (reference aggregator.py:224-270). Returns None if nothing to send."""
+        (reference aggregator.py:224-270). Returns None if nothing to send.
+
+        Quarantined holdings never fold: a multi-model partial
+        aggregates only the clean models and carries the excluded
+        peers as coverage-only passengers in its contributor list
+        (weight = the folded sample mass). A lone quarantined model is
+        pushed VERBATIM — the receiver scores it at its own intake,
+        which is how quarantine coverage (and the verdict itself)
+        spreads without ever folding poison."""
         except_nodes = except_nodes or []
         with self._lock:
             usable = sorted(
@@ -530,10 +675,29 @@ class Aggregator(ABC):
                 ),
                 key=lambda m: tuple(sorted(m.get_contributors())),
             )
+            excluded_ids = dict(self._excluded)
         if not usable:
             return None
         if len(usable) == 1:
             return usable[0]
         if not self.SUPPORTS_PARTIAL_AGGREGATION:
-            return None
-        return self.aggregate(usable)
+            # No combinable partial exists, but the exchange must still
+            # advance: hand the peer ONE of its missing
+            # single-contributor models per tick (deterministic sorted
+            # order, clean ones first) instead of going silent.
+            # Returning None here made coverage depend on the "peer is
+            # missing exactly one model I hold" coincidence — fine at 4
+            # trainers, but a 10-trainer Krum/TrimmedMean round stalled
+            # until AGGREGATION_TIMEOUT whenever the race lost.
+            singles = [m for m in usable if len(m.get_contributors()) == 1]
+            clean = [m for m in singles if id(m) not in excluded_ids]
+            pick = clean or singles
+            return pick[0] if pick else None
+        folded = [m for m in usable if id(m) not in excluded_ids]
+        if not folded:
+            # Every usable holding is quarantined: push one verbatim
+            # (single-contributor, assessable by the receiver) instead
+            # of aggregating poison.
+            return usable[0]
+        out = self.aggregate(folded)
+        return self._with_passengers(out, usable, excluded_ids)

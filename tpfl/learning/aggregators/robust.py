@@ -1,4 +1,5 @@
-"""Byzantine-robust aggregators: Krum, Multi-Krum, trimmed mean.
+"""Byzantine-robust aggregators: Krum, Multi-Krum, trimmed mean —
+streaming-capable and quarantine-aware.
 
 Not present in the reference, but the fork's raison d'être is adversarial
 robustness experimentation (sign-flip / additive-noise attacks,
@@ -6,28 +7,90 @@ robustness experimentation (sign-flip / additive-noise attacks,
 those attacks against. All scoring is jitted: pairwise distances are one
 ``(n, p) x (p, n)`` matmul on the MXU.
 
+Streaming (PR-3 API): each aggregator implements
+``acc_init/accumulate/finalize`` over a **bounded per-round candidate
+buffer** (``Settings.AGG_ROBUST_BUFFER``), so they compose with
+``Settings.AGG_STREAM_EAGER`` and hold O(buffer) — not O(contributor
+count) — memory at any federation size:
+
+- Krum / Multi-Krum accumulate each arrival as one row of a
+  preallocated ``(cap, p)`` **flat float32 projection** matrix (a
+  donated ``dynamic_update_slice`` per arrival — the flatten cost moves
+  off the round-close tail), plus the candidate's parameter pytree for
+  the final selection;
+- trimmed mean accumulates into a **per-leaf stacked reservoir**
+  (``(cap, *leaf.shape)`` per leaf, donated row writes, original leaf
+  dtypes — bfloat16 candidates stay bfloat16 until the fused
+  sort/mean).
+
+Past the cap both use seeded Vitter reservoir replacement (the
+FedMedian discipline): exact up to the cap, an unbiased sample beyond
+it, deterministic under ``Settings.SEED``.
+
+Quarantine-aware: when the node's
+:class:`~tpfl.management.quarantine.QuarantineEngine` is attached (and
+``Settings.QUARANTINE_ENABLED``), verdicts shrink the candidate set at
+finalize — a peer quarantined AFTER its contribution was buffered is
+dropped before Krum scoring / the trimmed sort, defense-in-depth on top
+of the intake-time exclusion in ``Aggregator.add_model``.
+
+Preconditions are validated, not silently clamped: Krum requires
+``n >= 2f + 3`` (Blanchard et al. 2017, Thm. 1) — an under-provisioned
+candidate set logs a warning and bumps
+``tpfl_agg_krum_underprovisioned_total``; a trimmed mean with
+``n <= 2*trim`` cannot trim at all — it warns, raises a ``no_trim``
+flight event, and the effective trim is surfaced as the
+``tpfl_agg_effective_trim`` gauge either way.
+
 - Krum / Multi-Krum: Blanchard et al. 2017.
 - Trimmed mean: Yin et al. 2018.
 """
 
 from __future__ import annotations
 
+import random
+import time
+import zlib
 from functools import partial
 
 import jax
 import jax.numpy as jnp
 
-from tpfl.learning.aggregators.aggregator import Aggregator, stack_models
+from tpfl.learning.aggregators.aggregator import Aggregator, AggStream
 from tpfl.learning.model import TpflModel
+from tpfl.management.logger import logger
+from tpfl.settings import Settings
 
 
 @jax.jit
-def _flatten_stacked(stacked):
-    """(n_models, total_params) matrix from a stacked pytree."""
-    leaves = jax.tree_util.tree_leaves(stacked)
+def _flatten_one(params):
+    """(total_params,) float32 vector from one pytree — the per-arrival
+    half of the old ``_flatten_stacked`` (same values, one model at a
+    time)."""
+    leaves = jax.tree_util.tree_leaves(params)
     return jnp.concatenate(
-        [x.reshape(x.shape[0], -1).astype(jnp.float32) for x in leaves], axis=1
+        [x.reshape(-1).astype(jnp.float32) for x in leaves]
     )
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _row_write(buf, row, idx):
+    """Write one flat candidate into slot ``idx`` of the (cap, p)
+    buffer IN PLACE (donated — no per-arrival buffer-sized alloc)."""
+    return jax.lax.dynamic_update_slice(buf, row[None, :], (idx, 0))
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _leaf_write(bufs, params, idx):
+    """Write one candidate pytree into slot ``idx`` of the per-leaf
+    (cap, *leaf) reservoir IN PLACE (donated)."""
+
+    def leaf(b, x):
+        return jax.lax.dynamic_update_slice(
+            b, x[None].astype(b.dtype), (idx,) + (0,) * x.ndim
+        )
+
+    return jax.tree_util.tree_map(leaf, bufs, params)
 
 
 @partial(jax.jit, static_argnums=(1,))
@@ -54,34 +117,172 @@ def _trimmed_mean(stacked, trim: int):
     return jax.tree_util.tree_map(leaf, stacked)
 
 
-class Krum(Aggregator):
-    """Select the single model closest to its peers (byzantine-robust)."""
+def krum_requirement_met(n: int, n_byzantine: int) -> bool:
+    """Blanchard et al.'s Krum precondition: ``n >= 2f + 3``. Below it
+    the n-f-2 neighborhood degenerates (clamped to 1) and the
+    selection guarantee no longer holds."""
+    return n >= 2 * n_byzantine + 3
+
+
+class _RobustStream(Aggregator):
+    """Shared bounded-candidate streaming plumbing for the robust
+    family: seeded reservoir slotting, per-candidate contributor/weight
+    bookkeeping, and the quarantine shrink at finalize. Subclasses
+    implement ``_buffer_write`` (how a candidate lands in device
+    buffers) and ``_finalize_kept`` (the robust math over the kept
+    slots)."""
 
     SUPPORTS_PARTIAL_AGGREGATION = False
+    SUPPORTS_STREAMING = True
+
+    def acc_init(self, template: TpflModel) -> AggStream:
+        st = AggStream(template)
+        st.extra["peers"] = []  # contributor tuple per slot
+        st.extra["weights"] = []  # num_samples per slot
+        st.extra["params"] = []  # parameter pytree per slot
+        st.extra["rng"] = random.Random(
+            (Settings.SEED or 0) ^ zlib.crc32(self.node_name.encode())
+        )
+        return st
+
+    def accumulate(
+        self, state: AggStream, model: TpflModel, weight: "float | None" = None
+    ) -> AggStream:
+        cap = max(1, int(Settings.AGG_ROBUST_BUFFER))
+        peers = state.extra["peers"]
+        if len(peers) < cap:
+            slot = len(peers)
+            peers.append(tuple(sorted(model.get_contributors())))
+            state.extra["weights"].append(int(model.get_num_samples()))
+            state.extra["params"].append(model.get_parameters())
+        else:
+            # Vitter's algorithm R (the FedMedian discipline): every
+            # candidate seen so far has equal probability of occupying
+            # the bounded buffer; deterministic under Settings.SEED.
+            j = state.extra["rng"].randint(0, state.count)
+            if j < cap:
+                slot = j
+                peers[slot] = tuple(sorted(model.get_contributors()))
+                state.extra["weights"][slot] = int(model.get_num_samples())
+                state.extra["params"][slot] = model.get_parameters()
+            else:
+                slot = None
+        if slot is not None:
+            self._buffer_write(state, model, slot, cap)
+        state.contributors.update(model.get_contributors())
+        state.num_samples += model.get_num_samples()
+        state.count += 1
+        state.offered += 1
+        return state
+
+    def _buffer_write(
+        self, state: AggStream, model: TpflModel, slot: int, cap: int
+    ) -> None:
+        raise NotImplementedError
+
+    def _kept_slots(self, state: AggStream) -> list[int]:
+        """Candidate slots surviving the quarantine shrink: verdicts
+        that landed after a contribution was buffered drop it before
+        any scoring. Fail-open (all slots kept, loud warning) when the
+        shrink would empty the candidate set — a defense never bricks
+        the round."""
+        peers = state.extra["peers"]
+        quarantined = self.quarantined_peers()
+        if not quarantined:
+            return list(range(len(peers)))
+        kept = [
+            i
+            for i, p in enumerate(peers)
+            if not (set(p) & quarantined)
+        ]
+        if not kept and peers:
+            logger.warning(
+                self.node_name,
+                f"Quarantine would drop every {type(self).__name__} "
+                "candidate; failing open to the full buffer",
+            )
+            return list(range(len(peers)))
+        if len(kept) < len(peers):
+            logger.metrics.counter(
+                "tpfl_agg_candidates_shrunk_total",
+                labels={"node": self.node_name},
+                value=len(peers) - len(kept),
+            )
+        return kept
+
+    def finalize(self, state: AggStream) -> TpflModel:
+        if not state.extra.get("peers"):
+            raise ValueError("No models to aggregate")
+        return self._finalize_kept(state, self._kept_slots(state))
+
+    def _finalize_kept(self, state: AggStream, kept: list[int]) -> TpflModel:
+        raise NotImplementedError
+
+
+class Krum(_RobustStream):
+    """Select the single model closest to its peers (byzantine-robust),
+    over the bounded streaming candidate buffer."""
 
     def __init__(self, node_name: str = "unknown", n_byzantine: int = 1) -> None:
         super().__init__(node_name)
         self.n_byzantine = int(n_byzantine)
 
-    def aggregate(self, models: list[TpflModel]) -> TpflModel:
-        if not models:
-            raise ValueError("No models to aggregate")
-        if len(models) == 1:
-            return models[0]
-        stacked, _ = stack_models(models)
-        scores = _krum_scores(_flatten_stacked(stacked), self.n_byzantine)
-        best = int(jnp.argmin(scores))
-        chosen = models[best]
-        contributors = sorted({c for m in models for c in m.get_contributors()})
-        return chosen.build_copy(
-            params=chosen.get_parameters(),
-            contributors=contributors,
-            num_samples=chosen.get_num_samples(),
+    def _buffer_write(
+        self, state: AggStream, model: TpflModel, slot: int, cap: int
+    ) -> None:
+        row = _flatten_one(model.get_parameters())
+        buf = state.extra.get("flat")
+        if buf is None:
+            buf = jnp.zeros((cap, row.shape[0]), jnp.float32)
+        state.extra["flat"] = _row_write(buf, row, jnp.int32(slot))
+
+    def _check_preconditions(self, n: int) -> None:
+        if not krum_requirement_met(n, self.n_byzantine):
+            logger.warning(
+                self.node_name,
+                f"Krum under-provisioned: {n} candidates < "
+                f"2*{self.n_byzantine}+3 (Blanchard's n >= 2f+3) — the "
+                "n-f-2 neighborhood degenerates and the selection "
+                "guarantee does not hold; lower n_byzantine or widen "
+                "the train set",
+            )
+            logger.metrics.counter(
+                "tpfl_agg_krum_underprovisioned_total",
+                labels={"node": self.node_name},
+            )
+
+    def _scores(self, state: AggStream, kept: list[int]):
+        """Krum scores over the kept candidate rows (host-side index
+        pick; the scoring itself is the one jitted Gram matmul)."""
+        n = len(state.extra["peers"])
+        flat = state.extra["flat"][:n]
+        if len(kept) < n:
+            flat = flat[jnp.asarray(kept, jnp.int32)]
+        return _krum_scores(flat, self.n_byzantine)
+
+    def _finalize_kept(self, state: AggStream, kept: list[int]) -> TpflModel:
+        self._check_preconditions(len(kept))
+        if len(kept) == 1:
+            best = kept[0]
+        else:
+            scores = self._scores(state, kept)
+            best = kept[int(jnp.argmin(scores))]
+        return state.template.build_copy(
+            params=state.extra["params"][best],
+            contributors=sorted(state.contributors),
+            num_samples=state.extra["weights"][best],
         )
 
 
 class MultiKrum(Krum):
-    """Average of the m best-scored models."""
+    """Sample-weighted average of the m best-scored models.
+
+    The selected models' parameters are averaged weighted by their
+    per-model sample counts (the FedAvg streaming kernels, reused);
+    the aggregate's metadata keeps the FULL input picture —
+    contributors = every input's union (round-coverage bookkeeping),
+    num_samples = every input's total — so no per-model sample mass is
+    silently dropped from downstream weighting."""
 
     def __init__(
         self, node_name: str = "unknown", n_byzantine: int = 1, m: int = 2
@@ -89,41 +290,95 @@ class MultiKrum(Krum):
         super().__init__(node_name, n_byzantine)
         self.m = int(m)
 
-    def aggregate(self, models: list[TpflModel]) -> TpflModel:
-        if not models:
-            raise ValueError("No models to aggregate")
-        if len(models) <= self.m:
-            selected = models
+    def _finalize_kept(self, state: AggStream, kept: list[int]) -> TpflModel:
+        self._check_preconditions(len(kept))
+        if len(kept) <= self.m:
+            selected = kept
         else:
-            stacked, _ = stack_models(models)
-            scores = _krum_scores(_flatten_stacked(stacked), self.n_byzantine)
+            scores = self._scores(state, kept)
             order = jnp.argsort(scores)[: self.m]
-            selected = [models[int(i)] for i in order]
-        from tpfl.learning.aggregators.fedavg import FedAvg
+            selected = [kept[int(i)] for i in order]
+        from tpfl.learning.aggregators.fedavg import (
+            _acc_finalize,
+            _acc_first,
+            _acc_update,
+        )
 
-        avg = FedAvg(self.node_name)
-        out = avg.aggregate(selected)
-        contributors = sorted({c for m in models for c in m.get_contributors()})
-        out.set_contribution(contributors, out.get_num_samples())
-        return out
+        acc = None
+        for i in sorted(selected):  # canonical fold order
+            w = jnp.float32(state.extra["weights"][i])
+            p = state.extra["params"][i]
+            acc = _acc_first(p, w) if acc is None else _acc_update(acc, p, w)
+        avg = _acc_finalize(acc, state.template.get_parameters())
+        return state.template.build_copy(
+            params=avg,
+            contributors=sorted(state.contributors),
+            num_samples=int(state.num_samples),
+        )
 
 
-class TrimmedMean(Aggregator):
-    """Coordinate-wise mean after trimming the k extremes per side."""
-
-    SUPPORTS_PARTIAL_AGGREGATION = False
+class TrimmedMean(_RobustStream):
+    """Coordinate-wise mean after trimming the k extremes per side,
+    over a bounded per-leaf streaming reservoir."""
 
     def __init__(self, node_name: str = "unknown", trim: int = 1) -> None:
         super().__init__(node_name)
         self.trim = int(trim)
 
-    def aggregate(self, models: list[TpflModel]) -> TpflModel:
-        if not models:
-            raise ValueError("No models to aggregate")
-        stacked, _ = stack_models(models)
+    def _buffer_write(
+        self, state: AggStream, model: TpflModel, slot: int, cap: int
+    ) -> None:
+        params = model.get_parameters()
+        bufs = state.extra.get("leaf_bufs")
+        if bufs is None:
+            bufs = jax.tree_util.tree_map(
+                lambda x: jnp.zeros((cap,) + jnp.shape(x), x.dtype), params
+            )
+        state.extra["leaf_bufs"] = _leaf_write(bufs, params, jnp.int32(slot))
+
+    def _finalize_kept(self, state: AggStream, kept: list[int]) -> TpflModel:
+        n = len(state.extra["peers"])
+        idx = jnp.asarray(kept, jnp.int32)
+        stacked = jax.tree_util.tree_map(
+            lambda b: b[:n][idx], state.extra["leaf_bufs"]
+        )
+        effective = self.trim if len(kept) > 2 * self.trim else 0
+        labels = {"node": self.node_name}
+        logger.metrics.gauge(
+            "tpfl_agg_effective_trim", float(effective), labels=labels
+        )
+        if effective == 0 and self.trim > 0:
+            # n <= 2*trim: nothing can be trimmed — the "robust" mean
+            # degenerates to the plain mean with ZERO byzantine
+            # tolerance. Silent before; now a warning + flight event +
+            # the zero effective trim above in the registry.
+            logger.warning(
+                self.node_name,
+                f"TrimmedMean cannot trim: {len(kept)} candidates <= "
+                f"2*trim ({self.trim}) — aggregating the PLAIN mean "
+                "with no byzantine tolerance; widen the train set or "
+                "lower trim",
+            )
+            logger.metrics.counter(
+                "tpfl_agg_trimmed_no_trim_total", labels=labels
+            )
+            from tpfl.management.telemetry import flight
+
+            flight.record(
+                self.node_name,
+                {
+                    "kind": "event",
+                    "name": "no_trim",
+                    "node": self.node_name,
+                    "trace": "",
+                    "t": time.monotonic(),
+                    "candidates": len(kept),
+                    "trim": self.trim,
+                },
+            )
         out = _trimmed_mean(stacked, self.trim)
-        contributors = sorted({c for m in models for c in m.get_contributors()})
-        total = int(sum(m.get_num_samples() for m in models))
-        return models[0].build_copy(
-            params=out, contributors=contributors, num_samples=total
+        return state.template.build_copy(
+            params=out,
+            contributors=sorted(state.contributors),
+            num_samples=int(state.num_samples),
         )

@@ -72,6 +72,10 @@ class Node:
         self.state = NodeState(self.addr, simulation=simulation)
         self.aggregator = aggregator if aggregator is not None else FedAvg()
         self.aggregator.node_name = self.addr
+        # Active-defense wiring: the aggregator consults the node's
+        # quarantine engine at every intake (one attribute read while
+        # Settings.QUARANTINE_ENABLED is off).
+        self.aggregator.set_quarantine(self.state.quarantine)
 
         if isinstance(learner, Learner):
             self.learner = learner

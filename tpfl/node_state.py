@@ -112,6 +112,19 @@ class NodeState:
 
         # unguarded: BaseCache is internally synchronized (own _lock).
         self.wire_bases = BaseCache()
+
+        # Active Byzantine defense (tpfl.management.quarantine): the
+        # per-node quarantine state machine Node wires into the
+        # aggregator's intake. Quarantine state deliberately SURVIVES
+        # round boundaries within an experiment — a peer flagged in
+        # round r stays excluded in round r+1 until probation clears
+        # it — and resets with the rest of the learning state when the
+        # experiment ends (clear()).
+        from tpfl.management.quarantine import QuarantineEngine
+
+        # unguarded: QuarantineEngine is internally synchronized (own
+        # _lock); the reference itself is written once here.
+        self.quarantine = QuarantineEngine(addr)
         # unguarded: handler threads add(), the learning thread tests
         # membership and replaces the set wholesale at round
         # boundaries — all GIL-atomic set ops on a best-effort hint
@@ -220,6 +233,7 @@ class NodeState:
         with self.nei_status_lock:
             self.nei_status = {}
         self.model_initialized_event.clear()
+        self.quarantine.reset()
 
     def __repr__(self) -> str:
         return (

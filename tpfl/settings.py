@@ -436,6 +436,48 @@ class Settings:
     """Trailing window (rounds/fits) for the ConvergenceMonitor's
     plateau/divergence tests and the loss-trajectory slope."""
 
+    # --- active Byzantine defense (quarantine) ---
+    QUARANTINE_ENABLED: bool = False
+    """Master gate for the active defense plane
+    (tpfl.management.quarantine): every single-contributor model at the
+    aggregation intake is live-scored by the learning-plane ledger's
+    AnomalyScorer (one fused jitted reduction, the PR-7 math) BEFORE it
+    can fold — contributions flagged sign-flip / norm-outlier are
+    excluded from the aggregate (kept as coverage-only passengers so
+    the round still closes), the flagged peer enters quarantine, and
+    subsequent clean contributions earn re-admission after
+    QUARANTINE_PROBATION_ROUNDS. Requires the ledger's round state:
+    enabling this activates the ledger's open-round/scoring taps even
+    when LEDGER_ENABLED is off (the observational knob only gates the
+    passive record path). Off by default — disabled, the intake is one
+    attribute read; enabled overhead is budgeted within the shared 5%
+    rounds/sec envelope (bench.py's byzantine tier off/on A/B). Unlike
+    the ledger, quarantine is NOT observational: verdicts change what
+    aggregates. Read at use time."""
+
+    QUARANTINE_PROBATION_ROUNDS: int = 2
+    """Rounds a quarantined peer's contributions must score clean
+    (strictly more than this many rounds past its last flagged round)
+    before it is re-admitted to the fold. Contributions during
+    probation are still scored — they earn the streak — but stay
+    excluded. A flagged contribution during probation re-arms the
+    window from its round."""
+
+    AGG_ROBUST_BUFFER: int = 64
+    """Candidate-buffer budget for the streaming robust aggregators
+    (Krum / MultiKrum / TrimmedMean): each keeps at most this many
+    per-round candidates on device — a flat float32 projection matrix
+    for Krum scoring, a per-leaf stacked reservoir for the trimmed
+    mean — with seeded reservoir sampling past the cap (exact up to
+    the cap, an unbiased sample beyond it), so peak memory is
+    O(buffer), not O(contributor count)."""
+
+    ATTACK_NOISE_STD: float = 0.1
+    """Default standard deviation for the additive-noise attack when an
+    AttackPlan rule does not set one (tpfl.attacks.plan; reference
+    exp_SAVE3.txt:213-223 uses 0.1). Bench/test machinery, not a
+    production knob."""
+
     # --- concurrency diagnostics ---
     LOCK_TRACING: bool = False
     """Opt-in runtime lock-order tracing (tpfl.concurrency): every lock
@@ -545,6 +587,13 @@ class Settings:
         cls.LEDGER_ANOMALY_COS = 0.0
         cls.LEDGER_ANOMALY_MIN_N = 4
         cls.LEDGER_CONVERGENCE_WINDOW = 5
+        # Active defense off by default (quarantine/robust tests and the
+        # bench byzantine tier toggle per-case) — verdicts change what
+        # aggregates, so seeded reference-parity runs keep it off.
+        cls.QUARANTINE_ENABLED = False
+        cls.QUARANTINE_PROBATION_ROUNDS = 2
+        cls.AGG_ROBUST_BUFFER = 64
+        cls.ATTACK_NOISE_STD = 0.1
 
     @classmethod
     def set_standalone_settings(cls) -> None:
@@ -618,6 +667,12 @@ class Settings:
         cls.LEDGER_ANOMALY_COS = 0.0
         cls.LEDGER_ANOMALY_MIN_N = 4
         cls.LEDGER_CONVERGENCE_WINDOW = 5
+        # Active defense is opt-in here too: enable QUARANTINE_ENABLED
+        # (with the ledger) for runs expected to contain adversaries.
+        cls.QUARANTINE_ENABLED = False
+        cls.QUARANTINE_PROBATION_ROUNDS = 2
+        cls.AGG_ROBUST_BUFFER = 64
+        cls.ATTACK_NOISE_STD = 0.1
 
     @classmethod
     def set_scale_settings(cls) -> None:
@@ -732,6 +787,13 @@ class Settings:
         cls.LEDGER_ANOMALY_COS = 0.0
         cls.LEDGER_ANOMALY_MIN_N = 4
         cls.LEDGER_CONVERGENCE_WINDOW = 5
+        # At 1000 in-process nodes the live-scoring dispatch per intake
+        # shares the one device queue with the vmapped fits — active
+        # defense stays an explicit opt-in at this profile's scale.
+        cls.QUARANTINE_ENABLED = False
+        cls.QUARANTINE_PROBATION_ROUNDS = 2
+        cls.AGG_ROBUST_BUFFER = 64
+        cls.ATTACK_NOISE_STD = 0.1
 
     @classmethod
     def snapshot(cls) -> dict[str, Any]:

@@ -296,7 +296,10 @@ class TrainStage(Stage):
         # contribution's update stats are measured against (the model
         # here is the adopted previous aggregate / init weights; the
         # fit below trains on a copy, so the reference stays intact).
-        if Settings.LEDGER_ENABLED:
+        # The active defense (QUARANTINE_ENABLED) scores its verdicts
+        # against the same reference, so it opens the round too even
+        # when the observational ledger knob is off.
+        if ledger.active():
             ledger.contrib.open_round(
                 node.addr, st.round,
                 node.learner.get_model().get_parameters(),
