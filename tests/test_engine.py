@@ -1,0 +1,418 @@
+"""Federation engine tests — the pod-scale seam on the 8-device
+virtual CPU mesh (conftest forces XLA_FLAGS
+--xla_force_host_platform_device_count=8).
+
+Pins the engine's three contracts (ISSUE 9): (a) the sharded program
+(gossip-as-psum-collective fold under shard_map) is numerically
+equivalent to the single-device program for FedAvg/SCAFFOLD/FedProx,
+including masked train sets and padded node axes; (b) same seed at a
+fixed device count is BYTE-identical across from-scratch runs; (c) the
+device-side multi-round window equals N single-round dispatches."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpfl.models import MLP
+from tpfl.parallel import (
+    FederationEngine,
+    VmapFederation,
+    create_mesh,
+    pad_node_axis,
+    pad_node_weights,
+    padded_node_count,
+    sample_participants,
+    shard_stacked,
+)
+from tpfl.settings import Settings
+
+
+def _mlp():
+    return MLP(hidden_sizes=(16,), compute_dtype=jnp.float32)
+
+
+def _data(n, nb=2, bs=8, seed=0):
+    rng = np.random.default_rng(seed)
+    xs = rng.random((n, nb, bs, 28, 28)).astype(np.float32)
+    ys = rng.integers(0, 10, (n, nb, bs)).astype(np.int32)
+    return xs, ys
+
+
+def _leaves(tree):
+    return [np.asarray(x) for x in jax.tree_util.tree_leaves(tree)]
+
+
+def _run_engine(n, mesh, algorithm, xs, ys, weights, n_rounds=1, epochs=1):
+    eng = FederationEngine(_mlp(), n, mesh=mesh, seed=0, algorithm=algorithm)
+    params = eng.init_params((28, 28))
+    dx, dy = eng.shard_data(xs, ys)
+    if algorithm == "scaffold":
+        state = eng.init_scaffold_state(params)
+        params, _aux, state, losses = eng.run_rounds(
+            params, dx, dy, weights=weights, n_rounds=n_rounds,
+            epochs=epochs, scaffold_state=state,
+        )
+        return eng, params, losses, state
+    params, losses = eng.run_rounds(
+        params, dx, dy, weights=weights, n_rounds=n_rounds, epochs=epochs
+    )
+    return eng, params, losses, None
+
+
+# --- (a) sharded == single-device, incl. masks and padding ---------------
+
+
+@pytest.mark.parametrize("algorithm", ["fedavg", "fedprox", "scaffold"])
+def test_sharded_round_matches_single_device(algorithm):
+    """The psum-collective fold over the 8-way mesh equals the
+    single-program einsum fold, with a masked (partial-participation)
+    train set."""
+    n = 8
+    xs, ys = _data(n)
+    w = np.asarray([1, 1, 0, 1, 0, 1, 1, 0], np.float32)
+    mesh = create_mesh({"nodes": 8})
+    _, p1, l1, s1 = _run_engine(n, None, algorithm, xs, ys, w, n_rounds=2)
+    _, p2, l2, s2 = _run_engine(n, mesh, algorithm, xs, ys, w, n_rounds=2)
+    for a, b in zip(_leaves(p1), _leaves(p2)):
+        np.testing.assert_allclose(a, b, atol=2e-6)
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), atol=2e-5)
+    if algorithm == "scaffold":
+        for a, b in zip(_leaves(s1), _leaves(s2)):
+            np.testing.assert_allclose(a, b, atol=2e-6)
+
+
+@pytest.mark.parametrize("algorithm", ["fedavg", "scaffold"])
+def test_padded_node_axis_matches_unpadded(algorithm):
+    """n=6 on an 8-device mesh pads to 8 with zero-weight clone rows;
+    the REAL rows must equal the meshless unpadded run exactly (the
+    masked fold ignores w=0 pad entries)."""
+    n = 6
+    xs, ys = _data(n)
+    w = np.asarray([1, 1, 0, 1, 1, 0], np.float32)
+    mesh = create_mesh({"nodes": 8})
+    eng_a, p_a, _, s_a = _run_engine(n, None, algorithm, xs, ys, w)
+    eng_b, p_b, _, s_b = _run_engine(n, mesh, algorithm, xs, ys, w)
+    assert eng_a.padded_nodes == 6 and eng_b.padded_nodes == 8
+    for a, b in zip(_leaves(eng_a.unpad(p_a)), _leaves(eng_b.unpad(p_b))):
+        assert a.shape[0] == 6 and b.shape[0] == 6
+        np.testing.assert_allclose(a, b, atol=2e-6)
+    if algorithm == "scaffold":
+        # c_global (replicated) must also agree under padding.
+        for a, b in zip(_leaves(s_a[1]), _leaves(s_b[1])):
+            np.testing.assert_allclose(a, b, atol=2e-6)
+
+
+def test_all_zero_weights_fallback_ignores_padding():
+    """All-zero round weights fall back to a uniform mean over REAL
+    nodes only — pad rows never enter the fallback denominator."""
+    n = 6
+    xs, ys = _data(n)
+    w = np.zeros((n,), np.float32)
+    mesh = create_mesh({"nodes": 8})
+    eng_a, p_a, _, _ = _run_engine(n, None, "fedavg", xs, ys, w)
+    eng_b, p_b, _, _ = _run_engine(n, mesh, "fedavg", xs, ys, w)
+    for a, b in zip(_leaves(eng_a.unpad(p_a)), _leaves(eng_b.unpad(p_b))):
+        np.testing.assert_allclose(a, b, atol=2e-6)
+
+
+# --- (b) byte-identical determinism at fixed device count ----------------
+
+
+@pytest.mark.parametrize("devices", [1, 8])
+def test_same_seed_same_devices_byte_identical(devices):
+    n = 8
+    xs, ys = _data(n)
+    w = np.asarray([1, 0, 1, 1, 0, 1, 1, 1], np.float32)
+
+    def digest():
+        mesh = create_mesh({"nodes": devices}, devices=jax.devices()[:devices])
+        mesh = mesh if devices > 1 else None
+        _, p, _, _ = _run_engine(n, mesh, "fedavg", xs, ys, w, n_rounds=3)
+        return b"".join(leaf.tobytes() for leaf in _leaves(p))
+
+    assert digest() == digest()
+
+
+# --- (c) multi-round window == N single-round dispatches -----------------
+
+
+@pytest.mark.parametrize("algorithm", ["fedavg", "scaffold"])
+def test_window_equals_sequential_rounds(algorithm):
+    n = 8
+    mesh = create_mesh({"nodes": 8})
+    xs, ys = _data(n)
+    w = np.asarray([1, 1, 1, 0, 1, 0, 1, 1], np.float32)
+    _, p_win, l_win, s_win = _run_engine(
+        n, mesh, algorithm, xs, ys, w, n_rounds=3
+    )
+
+    eng = FederationEngine(_mlp(), n, mesh=mesh, seed=0, algorithm=algorithm)
+    p = eng.init_params((28, 28))
+    dx, dy = eng.shard_data(xs, ys)
+    state = eng.init_scaffold_state(p) if algorithm == "scaffold" else None
+    for _ in range(3):
+        if algorithm == "scaffold":
+            p, _aux, state, losses = eng.round(
+                p, dx, dy, weights=w, scaffold_state=state
+            )
+        else:
+            p, losses = eng.round(p, dx, dy, weights=w)
+    for a, b in zip(_leaves(p_win), _leaves(p)):
+        np.testing.assert_allclose(a, b, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(l_win), np.asarray(losses), atol=1e-5
+    )
+
+
+def test_per_round_weight_schedule():
+    """[n_rounds, n] weights rotate participation inside ONE dispatch;
+    the result equals sequential rounds with the per-round masks."""
+    n = 8
+    mesh = create_mesh({"nodes": 8})
+    xs, ys = _data(n)
+    sched = np.zeros((2, n), np.float32)
+    sched[0, :4] = 1.0
+    sched[1, 4:] = 1.0
+    _, p_win, _, _ = _run_engine(n, mesh, "fedavg", xs, ys, sched, n_rounds=2)
+
+    eng = FederationEngine(_mlp(), n, mesh=mesh, seed=0)
+    p = eng.init_params((28, 28))
+    dx, dy = eng.shard_data(xs, ys)
+    for r in range(2):
+        p, _ = eng.round(p, dx, dy, weights=sched[r])
+    for a, b in zip(_leaves(p_win), _leaves(p)):
+        np.testing.assert_allclose(a, b, atol=1e-5)
+    with pytest.raises(ValueError, match="per-round weights"):
+        _run_engine(n, mesh, "fedavg", xs, ys, sched, n_rounds=3)
+
+
+# --- engine <-> VmapFederation parity ------------------------------------
+
+
+def test_vmap_federation_rides_engine_byte_identical():
+    """The legacy API's round program IS the engine's single-round
+    program: identical bytes out for identical seed/data."""
+    n = 4
+    xs, ys = _data(n)
+    w = np.asarray([1, 1, 0, 1], np.float32)
+    fed = VmapFederation(_mlp(), n, seed=0)
+    pf, lf = fed.round(
+        fed.init_params((28, 28)), jnp.asarray(xs), jnp.asarray(ys), weights=w
+    )
+    _, pe, le, _ = _run_engine(n, None, "fedavg", xs, ys, w)
+    for a, b in zip(_leaves(pf), _leaves(pe)):
+        np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(np.asarray(lf), np.asarray(le))
+
+
+def test_vmap_federation_run_rounds_window():
+    """VmapFederation.run_rounds (the FederationLearner window seam)
+    matches repeated round() calls."""
+    n = 4
+    xs, ys = _data(n)
+    fed_a = VmapFederation(_mlp(), n, seed=0)
+    p_a = fed_a.init_params((28, 28))
+    p_a, _ = fed_a.run_rounds(p_a, jnp.asarray(xs), jnp.asarray(ys), n_rounds=2)
+    fed_b = VmapFederation(_mlp(), n, seed=0)
+    p_b = fed_b.init_params((28, 28))
+    for _ in range(2):
+        p_b, _ = fed_b.round(p_b, jnp.asarray(xs), jnp.asarray(ys))
+    for a, b in zip(_leaves(p_a), _leaves(p_b)):
+        np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+def test_auto_mesh_resolves_from_shard_knobs():
+    Settings.SHARD_NODES = True
+    Settings.SHARD_DEVICES = 0
+    try:
+        eng = FederationEngine(_mlp(), 16, mesh="auto", seed=0)
+        assert eng.mesh is not None and eng.mesh.shape == {"nodes": 8}
+        Settings.SHARD_DEVICES = 2
+        eng2 = FederationEngine(_mlp(), 16, mesh="auto", seed=0)
+        assert eng2.mesh.shape == {"nodes": 2}
+        Settings.SHARD_NODES = False
+        assert FederationEngine(_mlp(), 16, mesh="auto", seed=0).mesh is None
+    finally:
+        Settings.SHARD_NODES = False
+        Settings.SHARD_DEVICES = 0
+
+
+# --- mesh padding helpers (satellite: federation_sharding fix) -----------
+
+
+def test_padded_node_count_and_helpers():
+    mesh = create_mesh({"nodes": 8})
+    assert padded_node_count(8, mesh) == 8
+    assert padded_node_count(9, mesh) == 16
+    assert padded_node_count(100, None) == 100
+    t = {"a": np.arange(12, dtype=np.float32).reshape(6, 2)}
+    padded = pad_node_axis(t, 8)
+    assert np.asarray(padded["a"]).shape == (8, 2)
+    # Pad rows clone row 0 (valid model rows, zero fold weight).
+    np.testing.assert_array_equal(
+        np.asarray(padded["a"])[6:], np.broadcast_to(t["a"][0], (2, 2))
+    )
+    w = pad_node_weights(np.ones(6, np.float32), 8)
+    np.testing.assert_array_equal(np.asarray(w), [1, 1, 1, 1, 1, 1, 0, 0])
+    w2 = pad_node_weights(np.ones((3, 6), np.float32), 8)
+    assert w2.shape == (3, 8)
+    np.testing.assert_array_equal(np.asarray(w2)[:, 6:], 0)
+
+
+def test_shard_stacked_pads_instead_of_replicating():
+    """An indivisible node count shards via padding — it must NOT
+    degrade to a replicated (or host-local single-device) placement."""
+    mesh = create_mesh({"nodes": 8})
+    x = np.ones((10, 4), np.float32)
+    placed = shard_stacked(mesh, {"x": x})["x"]
+    assert placed.shape == (16, 4)
+    assert not placed.sharding.is_fully_replicated
+    # Each device holds exactly 2 rows of the padded axis.
+    assert placed.addressable_shards[0].data.shape == (2, 4)
+    # No mesh: unchanged.
+    same = shard_stacked(None, {"x": x})["x"]
+    assert np.asarray(same).shape == (10, 4)
+
+
+# --- cross-device population sampling (sim100k pattern) ------------------
+
+
+def test_sample_participants_deterministic_and_distinct():
+    a = sample_participants(10_000, 64, seed=3, round=5)
+    b = sample_participants(10_000, 64, seed=3, round=5)
+    np.testing.assert_array_equal(a, b)
+    assert len(set(a.tolist())) == 64
+    c = sample_participants(10_000, 64, seed=3, round=6)
+    assert not np.array_equal(a, c)
+    with pytest.raises(ValueError):
+        sample_participants(4, 8, seed=0, round=0)
+
+
+def test_population_round_state_stays_o_active():
+    """The sim100k pattern in miniature: a 10k population with K=8
+    active per round — the only persistent state is ONE global model,
+    and every stacked array the engine touches has K (padded) rows."""
+    popl, K = 10_000, 8
+    mesh = create_mesh({"nodes": 8})
+    eng = FederationEngine(_mlp(), K, mesh=mesh, seed=0)
+    glob = jax.tree_util.tree_map(
+        lambda leaf: np.asarray(leaf[0]), eng.unpad(eng.init_params((28, 28)))
+    )
+    for r in range(2):
+        idx = sample_participants(popl, K, seed=0, round=r)
+        xs, ys = _data(K, nb=1, bs=4, seed=int(idx[0]))
+        p = eng.broadcast_params(glob)
+        assert all(
+            np.shape(leaf)[0] == eng.padded_nodes
+            for leaf in jax.tree_util.tree_leaves(p)
+        )
+        dx, dy = eng.shard_data(xs, ys)
+        p, losses = eng.round(p, dx, dy)
+        assert np.asarray(losses).shape == (eng.padded_nodes,)
+        glob = jax.tree_util.tree_map(
+            lambda leaf: np.asarray(leaf[0]), eng.unpad(p)
+        )
+    assert all(np.isfinite(leaf).all() for leaf in _leaves(glob))
+
+
+# --- aux (BatchNorm) path over the mesh ----------------------------------
+
+def _bn_cnn():
+    import flax.linen as nn
+
+    class BnCnn(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            if x.ndim == 3:
+                x = x[..., None]
+            x = nn.Conv(4, (3, 3))(x)
+            x = nn.relu(
+                nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+            )
+            x = jnp.mean(x, axis=(1, 2))
+            return nn.Dense(10)(x)
+
+    return BnCnn()
+
+
+@pytest.mark.parametrize("aux_mode", ["mean", "local"])
+def test_sharded_aux_round_matches_single_device(aux_mode):
+    n = 8
+    xs, ys = _data(n, nb=1, bs=4)
+    w = np.asarray([1, 1, 0, 1, 0, 1, 1, 0], np.float32)
+
+    def run(mesh):
+        eng = FederationEngine(
+            _bn_cnn(), n, mesh=mesh, seed=0, learning_rate=0.05,
+            aux_mode=aux_mode,
+        )
+        p, a = eng.init_state((28, 28))
+        dx, dy = eng.shard_data(xs, ys)
+        p, a, losses = eng.round(p, dx, dy, weights=w, aux=a)
+        return _leaves(p) + _leaves(a)
+
+    for got, want in zip(run(create_mesh({"nodes": 8})), run(None)):
+        np.testing.assert_allclose(got, want, atol=2e-6)
+
+
+# --- observatory / round-profiler wiring over the engine seams -----------
+
+
+def test_engine_profiling_seams():
+    """PR-6 observatory coverage over the engine: the wrapped program
+    registers a recompile-detection signature, the dispatch window
+    lands in the round profiler (one `dispatch` + `train` attribution
+    per WINDOW under the engine's node label), and the program cache
+    emits hit/miss events."""
+    from tpfl.management import profiling
+
+    Settings.PROFILING_ENABLED = True
+    profiling.rounds.reset()
+    profiling.observatory.reset()
+    try:
+        n = 8
+        xs, ys = _data(n, nb=1, bs=4)
+        eng = FederationEngine(_mlp(), n, mesh=create_mesh({"nodes": 8}), seed=0)
+        p = eng.init_params((28, 28))
+        dx, dy = eng.shard_data(xs, ys)
+        p, _ = eng.run_rounds(p, dx, dy, n_rounds=2)
+        p, _ = eng.run_rounds(p, dx, dy, n_rounds=2)
+
+        sigs = profiling.observatory.signature_counts()
+        engine_keys = [k for k in sigs if k.startswith("engine_round:plain")]
+        assert engine_keys and sigs[engine_keys[0]] == 1  # no recompiles
+        records = profiling.rounds.attribution()
+        mine = [r for r in records if r["node"].startswith("engine:")]
+        assert len(mine) == 2  # one attribution record per WINDOW
+        for rec in mine:
+            assert rec["parts"]["dispatch"] >= 0.0
+            assert rec["parts"]["train"] >= 0.0
+            assert rec["coverage"] >= 0.95
+    finally:
+        Settings.PROFILING_ENABLED = False
+        profiling.rounds.reset()
+        profiling.observatory.reset()
+
+
+def test_run_rounds_accepts_replicated_committed_inputs():
+    """FederationLearner re-stacks the single global model each protocol
+    round, so its stacked inputs arrive COMMITTED as replicated on the
+    mesh — run_rounds must reshard them onto the node axis (device_put)
+    rather than refuse like raw pjit in_shardings do."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mesh = create_mesh({"nodes": 8})
+    eng = FederationEngine(_mlp(), 4, mesh=mesh, seed=0)
+    glob = jax.tree_util.tree_map(
+        lambda leaf: np.asarray(leaf[0]), eng.unpad(eng.init_params((28, 28)))
+    )
+    restacked = jax.device_put(
+        eng.broadcast_params(glob), NamedSharding(mesh, PartitionSpec())
+    )
+    xs, ys = _data(4, nb=1, bs=4)
+    dx, dy = eng.shard_data(xs, ys)
+    p, losses = eng.run_rounds(restacked, dx, dy, n_rounds=2)
+    assert np.isfinite(np.asarray(losses)).all()
+    leaf = jax.tree_util.tree_leaves(p)[0]
+    assert not leaf.sharding.is_fully_replicated

@@ -72,6 +72,7 @@ GUARDED_MODULES = (
     "tpfl/learning/aggregators/robust.py",
     "tpfl/attacks/attacks.py",
     "tpfl/attacks/plan.py",
+    "tpfl/parallel/engine.py",
 )
 
 _GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)(\s+writes)?")

@@ -5,18 +5,35 @@ reference's only intra-host parallelism is a Ray actor pool multiplexing
 N learner *processes* over K CPUs (``actor_pool.py:69``), with weights
 round-tripping through pickle on every hop. Here:
 
-- :class:`VmapFederation` — N homogeneous FL nodes stacked on a leading
-  node axis; every node's local epoch runs inside ONE compiled XLA
-  program (vmap over lax.scan), the node axis is sharded over the device
-  mesh, and FedAvg is an exact on-device weighted reduction (XLA inserts
-  the all-reduce over ICI) instead of gossip-until-converged.
+- :class:`FederationEngine` — the pod-scale seam (tpfl.parallel.engine):
+  an ENTIRE federation round (per-node local train, gossip exchange,
+  streaming FedAvg/SCAFFOLD/FedProx fold) compiled to one sharded XLA
+  program over the mesh, gossip realized as ``lax.psum`` collectives on
+  the ``nodes`` axis, node counts padded to device multiples with
+  zero-weight rows, and multi-round ``lax.fori_loop`` windows that pay
+  the host dispatch RTT once per window (docs/scaling.md).
+- :class:`VmapFederation` — the stable high-level API over the engine:
+  N homogeneous FL nodes stacked on a leading node axis; every node's
+  local epoch runs inside ONE compiled XLA program (vmap over
+  lax.scan), the node axis is sharded over the device mesh, and FedAvg
+  is an exact on-device weighted reduction instead of
+  gossip-until-converged.
 - :func:`create_mesh` / :func:`federation_sharding` — mesh + sharding
   helpers for single-host (8-chip) and multi-host topologies.
 - :class:`ShardedTrainer` — data-parallel + FSDP sharding for one large
   model across the mesh (tpfl.parallel.sharded).
 """
 
-from tpfl.parallel.mesh import create_mesh, federation_sharding, replicated
+from tpfl.parallel.mesh import (
+    create_mesh,
+    federation_sharding,
+    pad_node_axis,
+    pad_node_weights,
+    padded_node_count,
+    replicated,
+    shard_stacked,
+)
+from tpfl.parallel.engine import FederationEngine, sample_participants
 from tpfl.parallel.federation import VmapFederation
 from tpfl.parallel.federation_learner import FederationLearner
 from tpfl.parallel.moe import make_moe_layer, moe_dispatch
@@ -46,6 +63,12 @@ __all__ = [
     "create_mesh",
     "federation_sharding",
     "replicated",
+    "padded_node_count",
+    "pad_node_axis",
+    "pad_node_weights",
+    "shard_stacked",
+    "FederationEngine",
+    "sample_participants",
     "VmapFederation",
     "FederationLearner",
     "ShardedTrainer",

@@ -1,0 +1,837 @@
+"""Federation engine — an entire federation round as ONE sharded XLA
+program over the TPU mesh, with a device-side multi-round loop.
+
+This is the pod-scale seam the rest of tpfl rides (Podracer's Anakin
+architecture: put the whole learner loop on device as one sharded
+program; BlazeFL's bar: the fast path stays seed-deterministic):
+
+- **Local train** — every node's local fit (epochs x scan over
+  batches) is one ``vmap`` over the node axis, exactly the math of
+  ``JaxLearner``/``VmapFederation`` (FedAvg, FedProx proximal pull,
+  SCAFFOLD control variates).
+- **Gossip as collective** — on a mesh the node axis is sharded over
+  chips (``shard_map`` + ``PartitionSpec("nodes")``) and the gossip
+  exchange + streaming FedAvg fold become per-device partial weighted
+  sums reduced by ``lax.psum`` over the ``nodes`` axis: the all-reduce
+  over ICI IS the intra-pod gossip. Without a mesh the fold is the
+  masked weighted einsum — numerically the path
+  ``VmapFederation.round`` always ran.
+- **Multi-round windows** — ``run_rounds(..., n_rounds=K)`` folds K
+  federation rounds into one ``lax.fori_loop`` inside the SAME
+  program, so the ~67 ms host dispatch RTT is paid once per window
+  instead of once per round (``Settings.SHARD_ROUNDS_PER_DISPATCH``).
+- **Node padding** — node counts that do not divide the mesh are
+  padded with zero-weight clone rows (``tpfl.parallel.mesh`` helpers);
+  the masked-mean fold ignores w=0 entries exactly, so padding is
+  numerics-free and every chip keeps an equal shard.
+
+Determinism discipline: at a FIXED device count, same seed => the same
+byte-identical global model (all reductions have a fixed shape and
+order); changing the device count regroups the fold's partial sums and
+may shift last-ulp bits — see docs/scaling.md. The single-device
+program is the exact ``VmapFederation`` round program, so the engine
+is numerically equivalent to the legacy per-round path there.
+
+Consumers: :class:`~tpfl.parallel.federation.VmapFederation` (all its
+round programs are built here), the batched-fit pool
+(:func:`build_batched_fit_program` / :func:`maybe_nodes_mesh`),
+:class:`~tpfl.parallel.federation_learner.FederationLearner` (round
+windows), and ``bench.py``'s ``multichip`` tier.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from tpfl.learning.jax_learner import (
+    TrainState,
+    cross_entropy_loss,
+    default_optimizer,
+    make_train_step,
+)
+from tpfl.management import profiling
+from tpfl.parallel.compat import shard_map
+from tpfl.parallel.mesh import (
+    NODE_AXIS,
+    create_mesh,
+    federation_sharding,
+    mesh_axis_size,
+    pad_node_axis,
+    pad_node_weights,
+    padded_node_count,
+    replicated,
+    valid_node_mask,
+)
+from tpfl.settings import Settings
+
+_ALGORITHMS = ("fedavg", "fedprox", "scaffold")
+
+
+# --- auto mesh resolution (Settings.SHARD_* knobs) -----------------------
+
+# unguarded: process-wide memo of immutable Mesh objects keyed by device
+# count; worst case under a race is building the same Mesh twice.
+_auto_meshes: dict[int, Mesh] = {}
+
+
+def shard_device_count() -> int:
+    """Devices the SHARD_* knobs allow the engine to spread over:
+    0 (default) = all local devices, else min(knob, available)."""
+    n = len(jax.devices())
+    cap = int(Settings.SHARD_DEVICES)
+    return n if cap <= 0 else min(cap, n)
+
+
+def auto_mesh() -> Optional[Mesh]:
+    """The ``nodes`` mesh the ``SHARD_NODES`` knob selects: all allowed
+    local devices on one ``nodes`` axis, or None when sharding is off
+    or there is only one device."""
+    if not Settings.SHARD_NODES:
+        return None
+    d = shard_device_count()
+    if d <= 1:
+        return None
+    mesh = _auto_meshes.get(d)
+    if mesh is None:
+        mesh = _auto_meshes[d] = create_mesh(
+            {NODE_AXIS: d}, devices=jax.devices()[:d]
+        )
+    return mesh
+
+
+def maybe_nodes_mesh(width: int) -> Optional[Mesh]:
+    """Mesh for sharding a batched node axis of ``width`` rows (the
+    batched-fit pool's chunk), or None when sharding is off, there is
+    one device, or ``width`` does not divide — the pool's power-of-two
+    bucketing makes divisibility the common case on 2^k-chip hosts."""
+    mesh = auto_mesh()
+    if mesh is None or width % mesh_axis_size(mesh) != 0:
+        return None
+    return mesh
+
+
+def sample_participants(
+    population: int, k: int, seed: int, round: int
+) -> np.ndarray:
+    """Deterministic per-round participant sample: ``k`` distinct
+    client indices out of ``population`` registered clients, seeded by
+    ``(seed, round)`` — the cross-device sampling discipline for
+    population scales where only the ACTIVE participants' state may
+    exist on host/device (sim100k: population state O(active), not
+    O(population))."""
+    if k > population:
+        raise ValueError(f"cannot sample {k} of {population} clients")
+    rng = np.random.default_rng(np.random.SeedSequence([seed, round]))
+    return np.sort(rng.choice(population, size=k, replace=False))
+
+
+# --- the engine ----------------------------------------------------------
+
+
+class FederationEngine:
+    """N-node federated training compiled to one (optionally sharded)
+    XLA round program with device-side multi-round windows.
+
+    Args mirror :class:`~tpfl.parallel.federation.VmapFederation` (it
+    delegates here): ``mesh`` may be a Mesh with a ``nodes`` axis,
+    None (single device), or ``"auto"`` (resolve from the
+    ``SHARD_NODES``/``SHARD_DEVICES`` knobs at construction).
+
+    Node-stacked state is padded to ``padded_nodes`` (a device
+    multiple) with zero-weight clone rows; ``unpad`` strips them on
+    host. Losses and stacked outputs ride padded."""
+
+    def __init__(
+        self,
+        module: Any,
+        n_nodes: int,
+        mesh: "Mesh | str | None" = None,
+        learning_rate: float = 0.1,
+        optimizer_factory: Optional[Callable] = None,
+        loss_fn: Callable = cross_entropy_loss,
+        seed: int = 0,
+        aux_mode: str = "mean",
+        algorithm: str = "fedavg",
+        prox_mu: float = 0.01,
+    ) -> None:
+        if aux_mode not in ("mean", "local"):
+            raise ValueError(f"aux_mode must be 'mean' or 'local', got {aux_mode!r}")
+        if algorithm not in _ALGORITHMS:
+            raise ValueError(
+                f"algorithm must be one of {_ALGORITHMS}, got {algorithm!r}"
+            )
+        self.module = module
+        self.n_nodes = int(n_nodes)
+        self.mesh = auto_mesh() if mesh == "auto" else mesh
+        self.learning_rate = float(learning_rate)
+        self._opt = (optimizer_factory or default_optimizer)(learning_rate)
+        self._loss_fn = loss_fn
+        self.seed = seed
+        self.aux_mode = aux_mode
+        self.algorithm = algorithm
+        self.prox_mu = float(prox_mu)
+        #: Stacked leading dimension: n_nodes rounded up to a device
+        #: multiple (== n_nodes without a mesh).
+        self.padded_nodes = padded_node_count(self.n_nodes, self.mesh)
+        # unguarded: single-owner — an engine is built and driven by one
+        # thread (a learner's fit thread or the bench); the caches below
+        # are only touched from that thread.
+        self._programs: dict[tuple, Callable] = {}
+        # unguarded: single-owner (see _programs)
+        self._wrapped: dict[tuple, Callable] = {}
+        # unguarded: single-owner (see _programs)
+        self._eval_fns: dict[bool, Callable] = {}
+        # unguarded: single-owner (see _programs) — dispatch-window
+        # ordinal for round-profiler attribution labels.
+        self._windows = 0
+        #: [padded_nodes] 1/0 mask of real vs pad rows (the uniform
+        #: fallback denominator when a round's weights are all-zero).
+        self.valid = valid_node_mask(self.n_nodes, self.padded_nodes)
+
+    # --- state / data placement ---
+
+    def _shard(self, tree: Any) -> Any:
+        if self.mesh is None:
+            return tree
+        return jax.device_put(tree, federation_sharding(self.mesh))
+
+    def init_state(self, input_shape: tuple[int, ...]) -> tuple[Any, Any]:
+        """(stacked params, stacked aux) on the padded node axis — aux
+        is ``{}`` for modules without mutable collections."""
+        dummy = jnp.zeros((1, *input_shape), jnp.float32)
+        variables = self.module.init(
+            jax.random.PRNGKey(self.seed), dummy, train=False
+        )
+        params = variables["params"]
+        aux = {k: v for k, v in variables.items() if k != "params"}
+        return (
+            self._shard(self.broadcast_params(params)),
+            self._shard(self.broadcast_params(aux)),
+        )
+
+    def init_params(self, input_shape: tuple[int, ...]) -> Any:
+        """Stacked [padded_nodes, ...] params (aux-free modules)."""
+        params, aux = self.init_state(input_shape)
+        if aux:
+            raise ValueError(
+                f"Module has mutable collections {sorted(aux)} — use "
+                f"init_state() and pass aux to round()/evaluate()."
+            )
+        return params
+
+    def init_scaffold_state(self, params: Any) -> tuple[Any, Any]:
+        """(c_locals [padded, ...], c_global [...]) zero control
+        variates; c_global replicated on the mesh."""
+        c_locals = jax.tree_util.tree_map(jnp.zeros_like, params)
+        c_global = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape[1:], p.dtype), params
+        )
+        if self.mesh is not None:
+            c_global = jax.device_put(c_global, replicated(self.mesh))
+        return self._shard(c_locals), c_global
+
+    def broadcast_params(self, tree: Any) -> Any:
+        """One model's tree broadcast onto the padded node axis — the
+        cross-device pattern: the global model is the ONLY persistent
+        state; stacking K active participants from it each round keeps
+        memory O(active), not O(population)."""
+        n = self.padded_nodes
+        return jax.tree_util.tree_map(
+            lambda p: jnp.broadcast_to(jnp.asarray(p)[None], (n, *jnp.shape(p))),
+            tree,
+        )
+
+    def pad_stacked(self, tree: Any) -> Any:
+        """Pad a node-stacked tree's leading axis to ``padded_nodes``
+        (clone rows; exact no-op when already padded)."""
+        return pad_node_axis(tree, self.padded_nodes)
+
+    def pad_weights(self, weights: Optional[Any]) -> Any:
+        """[n] (or per-round [R, n]) weights -> padded f32 with zero
+        pad entries; None -> uniform full participation."""
+        if weights is None:
+            weights = jnp.ones((self.n_nodes,), jnp.float32)
+        return pad_node_weights(weights, self.padded_nodes)
+
+    def unpad(self, tree: Any) -> Any:
+        """Strip pad rows from a node-stacked output (host-side)."""
+        if self.padded_nodes == self.n_nodes:
+            return tree
+        return jax.tree_util.tree_map(lambda x: x[: self.n_nodes], tree)
+
+    def shard_data(self, xs: Any, ys: Any) -> tuple[Any, Any]:
+        """Pad + place node-stacked batch arrays [n, n_batches, b, ...]
+        on the mesh (node axis sharded)."""
+        return (
+            self._shard(self.pad_stacked(jnp.asarray(xs))),
+            self._shard(self.pad_stacked(jnp.asarray(ys))),
+        )
+
+    # --- program construction -------------------------------------------
+
+    def _kind(self, aux: Optional[Any]) -> str:
+        if self.algorithm == "scaffold":
+            return "scaffold"
+        return "aux" if aux is not None else "plain"
+
+    def _make_prox(self) -> Callable[[Any, Any], Any]:
+        """FedProx proximal term ``mu/2·||p - p0||²`` (constant 0.0
+        for other algorithms keeps the round program free of the dead
+        subtraction tree)."""
+        if self.algorithm != "fedprox":
+            return lambda p, p0: 0.0
+        mu = self.prox_mu
+
+        def prox(p, p0):
+            sq = sum(
+                jnp.sum((a.astype(jnp.float32) - b.astype(jnp.float32)) ** 2)
+                for a, b in zip(
+                    jax.tree_util.tree_leaves(p), jax.tree_util.tree_leaves(p0)
+                )
+            )
+            return 0.5 * mu * sq
+
+        return prox
+
+    def _build_local_train(self, kind: str) -> Callable:
+        """One node's local fit — the exact per-kind math of the legacy
+        ``VmapFederation`` builders, unified behind a
+        ``(params, c_i, c_g, aux, xb, yb) -> (params, c_i, aux, loss)``
+        signature (``c_i``/``c_g``/``aux`` are empty pytrees for kinds
+        that do not thread them, which XLA erases)."""
+        opt, loss_fn, module = self._opt, self._loss_fn, self.module
+        prox = self._make_prox()
+        lr = self.learning_rate
+
+        def local_train(params, c_i, c_g, aux, xb, yb, epochs):
+            p0 = params  # round-start weights (FedProx anchor)
+            if kind == "scaffold":
+                # Fixed during the round (computed once, like the
+                # protocol path's ScaffoldCallback).
+                corr = jax.tree_util.tree_map(
+                    lambda c, ci: (c - ci).astype(c.dtype), c_g, c_i
+                )
+            opt_state = opt.init(params)
+
+            def batch_step(carry, batch):
+                p, o, a = carry
+                x, y = batch
+
+                if kind == "plain":
+
+                    def loss_of(pp):
+                        logits = module.apply({"params": pp}, x, train=False)
+                        return loss_fn(logits, y).mean() + prox(pp, p0)
+
+                    loss, grads = jax.value_and_grad(loss_of)(p)
+                    new_a = a
+                else:
+
+                    def loss_of(pp):
+                        logits, new_a = module.apply(
+                            {"params": pp, **a}, x, train=True, mutable=list(a)
+                        )
+                        if kind == "scaffold":
+                            return loss_fn(logits, y).mean(), new_a
+                        return loss_fn(logits, y).mean() + prox(pp, p0), new_a
+
+                    (loss, new_a), grads = jax.value_and_grad(
+                        loss_of, has_aux=True
+                    )(p)
+                if kind == "scaffold":
+                    grads = jax.tree_util.tree_map(
+                        lambda g, c: g + c.astype(g.dtype), grads, corr
+                    )
+                updates, o = opt.update(grads, o, p)
+                p = optax.apply_updates(p, updates)
+                return (p, o, new_a), loss
+
+            if epochs <= 0:  # static: aggregation-only round
+                variables = {"params": params, **aux} if kind != "plain" else {
+                    "params": params
+                }
+                logits = module.apply(variables, xb[0], train=False)
+                return params, c_i, aux, loss_fn(logits, yb[0]).mean()
+
+            def epoch_body(_, carry):
+                p, o, a, _last = carry
+                (p, o, a), losses = lax.scan(batch_step, (p, o, a), (xb, yb))
+                # Thread the epoch's mean loss through the carry — no
+                # extra forward pass after the loop.
+                return (p, o, a, jnp.mean(losses))
+
+            params, opt_state, aux, loss = lax.fori_loop(
+                0, epochs, epoch_body,
+                (params, opt_state, aux, jnp.float32(0)),
+            )
+            if kind == "scaffold":
+                # Option II: c_i+ = c_i - c + (x - y)/(K·lr)
+                k_steps = epochs * xb.shape[0]
+                scale = 1.0 / max(k_steps * lr, 1e-12)
+                c_i = jax.tree_util.tree_map(
+                    lambda ci, cg, x0, y_: (
+                        ci.astype(jnp.float32)
+                        - cg.astype(jnp.float32)
+                        + scale
+                        * (x0.astype(jnp.float32) - y_.astype(jnp.float32))
+                    ).astype(ci.dtype),
+                    c_i, c_g, p0, params,
+                )
+            return params, c_i, aux, loss
+
+        return local_train
+
+    @staticmethod
+    def _fold_weights(weights, valid, psum_axis):
+        """Normalized fold weights: ``weights / Σweights`` with a
+        uniform-over-REAL-nodes fallback when all-zero (pad rows never
+        enter the fallback). Sums are global — on a sharded mesh each
+        device's partial sum is psum-reduced over the ``nodes`` axis
+        (the first collective of the gossip exchange)."""
+        total = jnp.sum(weights)
+        valid_total = jnp.sum(valid)
+        if psum_axis is not None:
+            total = lax.psum(total, psum_axis)
+            valid_total = lax.psum(valid_total, psum_axis)
+        fallback = valid / jnp.maximum(valid_total, 1.0)
+        return jnp.where(
+            total > 0, weights / jnp.maximum(total, 1e-9), fallback
+        )
+
+    def _build_fold(self, kind: str, psum_axis: Optional[str]) -> Callable:
+        """Masked FedAvg fold + full-model diffusion (+ the SCAFFOLD
+        server update / aux aggregation). ``psum_axis`` None = the
+        single-program einsum over the whole node axis (the legacy
+        ``VmapFederation`` reduction); set = per-device partial sums
+        all-reduced by ``lax.psum`` — gossip as a mesh collective."""
+        aux_mode = self.aux_mode
+        n_logical = self.n_nodes
+
+        def leaf_mean_of(wnorm):
+            def leaf_mean(p):
+                w = wnorm.astype(jnp.float32)
+                # Masked-out (w=0) nodes are zeroed BEFORE the
+                # reduction — a w=0 node whose params overflowed would
+                # otherwise contribute 0 * inf = NaN.
+                sel = w.reshape((-1,) + (1,) * (p.ndim - 1)) > 0
+                clean = jnp.where(sel, p.astype(jnp.float32), 0.0)
+                agg = jnp.einsum("n,n...->...", w, clean)
+                if psum_axis is not None:
+                    agg = lax.psum(agg, psum_axis)
+                return agg.astype(p.dtype)
+
+            return leaf_mean
+
+        def diffuse(tree, wnorm, n_local):
+            leaf_mean = leaf_mean_of(wnorm)
+            agg = jax.tree_util.tree_map(leaf_mean, tree)
+            # Every node receives the aggregate (the FullModelCommand
+            # equivalent of the protocol path) — on a mesh this is the
+            # broadcast leg of the gossip collective.
+            return jax.tree_util.tree_map(
+                lambda a: jnp.broadcast_to(a[None], (n_local, *a.shape)), agg
+            )
+
+        def fold(trained, new_c, new_aux, c_locals, c_global, aux, weights,
+                 valid):
+            n_local = weights.shape[0]
+            wnorm = self._fold_weights(weights, valid, psum_axis)
+            out_params = diffuse(trained, wnorm, n_local)
+            sel = weights > 0
+
+            def keep_elected(new, old):
+                return jnp.where(
+                    sel.reshape((-1,) + (1,) * (new.ndim - 1)), new, old
+                )
+
+            if kind == "scaffold":
+                out_c = jax.tree_util.tree_map(keep_elected, new_c, c_locals)
+                # c += (|S|/N) · mean over ELECTED of delta_c (uniform
+                # mean per the paper, N = LOGICAL federation size —
+                # pad rows are never elected).
+                mask = sel.astype(jnp.float32)
+                elected = jnp.sum(mask)
+                if psum_axis is not None:
+                    elected = lax.psum(elected, psum_axis)
+                um = self._fold_weights(mask, valid, psum_axis)
+                uniform_mean = leaf_mean_of(um)
+                frac = elected / n_logical
+                out_cg = jax.tree_util.tree_map(
+                    lambda cg, dcm: (
+                        cg.astype(jnp.float32) + frac * dcm.astype(jnp.float32)
+                    ).astype(cg.dtype),
+                    c_global,
+                    jax.tree_util.tree_map(
+                        lambda n, o: uniform_mean(
+                            n.astype(jnp.float32) - o.astype(jnp.float32)
+                        ),
+                        new_c, c_locals,
+                    ),
+                )
+            else:
+                out_c, out_cg = c_locals, c_global
+            if kind == "plain":
+                out_aux = aux
+            elif aux_mode == "local":
+                # FedBN: stats stay per-node — but a w=0 node did not
+                # participate, so its private stats must not advance.
+                out_aux = jax.tree_util.tree_map(keep_elected, new_aux, aux)
+            else:
+                out_aux = diffuse(new_aux, wnorm, n_local)
+            return out_params, out_c, out_cg, out_aux
+
+        return fold
+
+    def _build_multi(
+        self, kind: str, epochs: int, n_rounds: int, w_ndim: int
+    ) -> Callable:
+        """The UNJITTED federation program (shard_map-wrapped on a
+        mesh): ``fn(params, c_locals, c_global, aux, xs, ys, weights,
+        valid) -> (params, c_locals, c_global, aux, losses)`` with
+        ``epochs`` and ``n_rounds`` baked in. One round is local train
+        (vmap) + fold; ``n_rounds > 1`` wraps it in a device-side
+        fori_loop so the dispatch RTT is paid once per window.
+        ``VmapFederation``'s builders trace this inside their own jits
+        (keeping ``.lower()`` and the legacy donation signatures);
+        :meth:`program` jits it directly."""
+        local_train = self._build_local_train(kind)
+        mesh = self.mesh
+        sharded = mesh is not None and mesh_axis_size(mesh) > 1
+        fold = self._build_fold(kind, NODE_AXIS if sharded else None)
+
+        def round_body(params, c_locals, c_global, aux, xs, ys, w, valid):
+            trained, new_c, new_aux, losses = jax.vmap(
+                lambda p, ci, a, x, y: local_train(
+                    p, ci, c_global, a, x, y, epochs
+                )
+            )(params, c_locals, aux, xs, ys)
+            out_params, out_c, out_cg, out_aux = fold(
+                trained, new_c, new_aux, c_locals, c_global, aux, w, valid
+            )
+            return out_params, out_c, out_cg, out_aux, losses
+
+        def multi(params, c_locals, c_global, aux, xs, ys, weights, valid):
+            if n_rounds == 1:
+                w = weights if w_ndim == 1 else weights[0]
+                return round_body(
+                    params, c_locals, c_global, aux, xs, ys, w, valid
+                )
+
+            def body(r, carry):
+                p, ci, cg, a, _ = carry
+                w = weights if w_ndim == 1 else weights[r]
+                return round_body(p, ci, cg, a, xs, ys, w, valid)
+
+            init_losses = jnp.zeros((valid.shape[0],), jnp.float32)
+            return lax.fori_loop(
+                0, n_rounds, body,
+                (params, c_locals, c_global, aux, init_losses),
+            )
+
+        if not sharded:
+            return multi
+
+        node = PartitionSpec(NODE_AXIS)
+        repl = PartitionSpec()
+        w_spec = node if w_ndim == 1 else PartitionSpec(None, NODE_AXIS)
+        return shard_map(
+            multi,
+            mesh=mesh,
+            in_specs=(node, node, repl, node, node, node, w_spec, node),
+            out_specs=(node, node, repl, node, node),
+            check_vma=False,
+        )
+
+    def raw_program(
+        self, kind: str, epochs: int, n_rounds: int = 1, w_ndim: int = 1
+    ) -> Callable:
+        """Cached UNJITTED program (shard_map-wrapped on a mesh) for
+        tracing inside a caller's own jit."""
+        key = ("raw", kind, int(epochs), int(n_rounds), int(w_ndim))
+        fn = self._programs.get(key)
+        if fn is None:
+            fn = self._programs[key] = self._build_multi(*key[1:])
+        return fn
+
+    def _build_program(
+        self, kind: str, epochs: int, n_rounds: int, w_ndim: int
+    ) -> Callable:
+        multi = self._build_multi(kind, epochs, n_rounds, w_ndim)
+        mesh = self.mesh
+        if mesh is None or mesh_axis_size(mesh) <= 1:
+            return jax.jit(multi, donate_argnums=(0, 1, 2, 3))
+        ns = federation_sharding(mesh)
+        rs = replicated(mesh)
+        ws = ns if w_ndim == 1 else NamedSharding(
+            mesh, PartitionSpec(None, NODE_AXIS)
+        )
+        return jax.jit(
+            multi,
+            donate_argnums=(0, 1, 2, 3),
+            in_shardings=(ns, ns, rs, ns, ns, ns, ws, ns),
+            out_shardings=(ns, ns, rs, ns, ns),
+        )
+
+    def program(
+        self, kind: str, epochs: int, n_rounds: int = 1, w_ndim: int = 1
+    ) -> Callable:
+        """Cached compiled program for ``(kind, epochs, n_rounds,
+        w_ndim)`` — the raw jitted callable (bench drives these from
+        inside its own timed loops)."""
+        key = (kind, int(epochs), int(n_rounds), int(w_ndim))
+        fn = self._programs.get(key)
+        profiling.observatory.cache_event("engine_programs", hit=fn is not None)
+        if fn is None:
+            fn = self._programs[key] = self._build_program(*key)
+        return fn
+
+    def _wrapped_program(
+        self, kind: str, epochs: int, n_rounds: int, w_ndim: int
+    ) -> Callable:
+        """The same program behind the compile observatory's recompile
+        detection (keyed per (engine program, abstract shapes) like
+        every other jit seam)."""
+        key = (kind, int(epochs), int(n_rounds), int(w_ndim))
+        fn = self._wrapped.get(key)
+        if fn is None:
+            fn = self._wrapped[key] = profiling.observatory.wrap(
+                self.program(*key),
+                f"engine_round:{kind}x{n_rounds}:"
+                f"{profiling.module_tag(self.module)}",
+            )
+        return fn
+
+    # --- execution -------------------------------------------------------
+
+    def round(
+        self,
+        params: Any,
+        xs: Any,
+        ys: Any,
+        weights: Optional[Any] = None,
+        epochs: int = 1,
+        aux: Optional[Any] = None,
+        scaffold_state: Optional[tuple[Any, Any]] = None,
+    ) -> tuple[Any, ...]:
+        """One federated round (``run_rounds`` with a window of 1 —
+        the single-round program carries no loop wrapper, so it is the
+        exact legacy ``VmapFederation.round`` computation)."""
+        return self.run_rounds(
+            params, xs, ys, weights=weights, epochs=epochs, n_rounds=1,
+            aux=aux, scaffold_state=scaffold_state,
+        )
+
+    def run_rounds(
+        self,
+        params: Any,
+        xs: Any,
+        ys: Any,
+        weights: Optional[Any] = None,
+        epochs: int = 1,
+        n_rounds: int = 1,
+        aux: Optional[Any] = None,
+        scaffold_state: Optional[tuple[Any, Any]] = None,
+    ) -> tuple[Any, ...]:
+        """Run ``n_rounds`` federation rounds in ONE device dispatch.
+
+        ``weights``: [n] per-node FedAvg weight (0 = not elected),
+        or [n_rounds, n] for per-round participation; None = uniform
+        full participation. Data is reused across the window's rounds
+        (the bench/simulation semantics; re-stack between windows for
+        fresh data).
+
+        Returns (params, losses) — with ``aux`` (possibly ``{}``)
+        (params, aux, losses) — and for algorithm="scaffold"
+        (params, aux, (c_locals, c_global), losses), matching
+        ``VmapFederation.round``. ``losses`` is the LAST round's
+        per-node loss vector (padded length)."""
+        kind = self._kind(aux)
+        if kind == "scaffold" and scaffold_state is None:
+            raise ValueError(
+                "algorithm='scaffold' requires scaffold_state "
+                "(init_scaffold_state(params))"
+            )
+        w = self.pad_weights(weights)
+        if w.ndim == 2 and w.shape[0] != n_rounds:
+            raise ValueError(
+                f"per-round weights have {w.shape[0]} rows for "
+                f"{n_rounds} rounds"
+            )
+        # Explicit placement, not just padding: callers re-stacking from
+        # a single global model (FederationLearner each protocol round)
+        # hand in arrays COMMITTED as replicated on the mesh, which the
+        # program's in_shardings would reject — device_put reshards
+        # committed arrays where pjit refuses to. No-op (same buffer)
+        # when the sharding already matches.
+        params = self._shard(self.pad_stacked(params))
+        xs = self._shard(self.pad_stacked(xs))
+        ys = self._shard(self.pad_stacked(ys))
+        c_locals, c_global = ({}, {})
+        if kind == "scaffold":
+            c_locals, c_global = scaffold_state
+            c_locals = self._shard(self.pad_stacked(c_locals))
+            if self.mesh is not None:
+                c_global = jax.device_put(c_global, replicated(self.mesh))
+        a = {} if aux is None else self._shard(self.pad_stacked(aux))
+        if self.mesh is not None:
+            w = jax.device_put(
+                w,
+                federation_sharding(self.mesh)
+                if w.ndim == 1
+                else NamedSharding(self.mesh, PartitionSpec(None, NODE_AXIS)),
+            )
+        fn = self._wrapped_program(kind, epochs, n_rounds, w.ndim)
+
+        prof = profiling.rounds.enabled()
+        node_tag = f"engine:{profiling.module_tag(self.module)}"
+        if prof:
+            self._windows += 1
+            profiling.rounds.begin_round(node_tag, self._windows)
+        t0 = time.monotonic() if prof else 0.0
+        out_params, out_c, out_cg, out_aux, losses = fn(
+            params, c_locals, c_global, a, xs, ys, w, self.valid
+        )
+        if prof:
+            t1 = time.monotonic()
+            jax.block_until_ready(losses)
+            t2 = time.monotonic()
+            # The dispatch gap is paid ONCE for the whole window — the
+            # engine's core claim, visible in tpfl_round_attr_seconds.
+            profiling.rounds.add(node_tag, "dispatch", t1 - t0)
+            profiling.rounds.add(node_tag, "train", t2 - t1)
+            profiling.rounds.end_round(node_tag, self._windows)
+
+        if kind == "scaffold":
+            return out_params, out_aux, (out_c, out_cg), losses
+        if aux is not None:
+            return out_params, out_aux, losses
+        return out_params, losses
+
+    # --- evaluation ------------------------------------------------------
+
+    def _build_eval(self, with_aux: bool) -> Callable:
+        module = self.module
+        loss_fn = self._loss_fn
+
+        @jax.jit
+        def eval_fn(params, aux, xs, ys):
+            def one_node(p, a, xb, yb):
+                def one_batch(carry, batch):
+                    x, y = batch
+                    logits = module.apply({"params": p, **a}, x, train=False)
+                    loss = loss_fn(logits, y).mean()
+                    acc = jnp.mean(jnp.argmax(logits, -1) == y)
+                    return carry, (loss, acc)
+
+                _, (losses, accs) = lax.scan(one_batch, 0.0, (xb, yb))
+                return jnp.mean(losses), jnp.mean(accs)
+
+            return jax.vmap(one_node)(params, aux, xs, ys)
+
+        if with_aux:
+            return eval_fn
+        return jax.jit(lambda params, xs, ys: eval_fn(params, {}, xs, ys))
+
+    def evaluate(
+        self, params: Any, xs: Any, ys: Any, aux: Optional[Any] = None
+    ) -> tuple[Any, Any]:
+        """Per-node (loss, accuracy) over node-stacked eval data."""
+        with_aux = aux is not None
+        fn = self._eval_fns.get(with_aux)
+        if fn is None:
+            fn = self._eval_fns[with_aux] = self._build_eval(with_aux)
+        if with_aux:
+            return fn(
+                self.pad_stacked(params), self.pad_stacked(aux),
+                self.pad_stacked(xs), self.pad_stacked(ys),
+            )
+        return fn(
+            self.pad_stacked(params), self.pad_stacked(xs),
+            self.pad_stacked(ys),
+        )
+
+
+# --- batched-fit programs (the pool's side of the seam) ------------------
+
+
+def build_masked_local_fit(
+    module: Any,
+    opt: Any,
+    loss_fn: Callable,
+    has_aux: bool,
+    track_grads: bool,
+    epochs: int,
+) -> Callable:
+    """One node's masked local fit for the batched pool: epochs x scan
+    over batches through :func:`make_train_step` (THE local SGD step —
+    identical numerics to ``JaxLearner.fit``), with per-batch 0/1
+    masks turning padding batches into exact no-ops and optional raw-
+    gradient accumulation (SCAFFOLD's control variates)."""
+    step = make_train_step(module, loss_fn, has_aux, with_grads=track_grads)
+
+    def local_fit(params, aux, correction, anchor, mu, xs, ys, bmask):
+        state = TrainState.create(
+            apply_fn=None, params=params, tx=opt, aux_state=aux
+        )
+        gsum0 = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(
+                p.shape, jnp.promote_types(p.dtype, jnp.float32)
+            ),
+            state.params,
+        ) if track_grads else jnp.float32(0)
+
+        def batch_step(carry, batch):
+            st, gsum = carry
+            x, y, m = batch
+            if track_grads:
+                st2, (loss, _acc, g) = step(st, x, y, correction, anchor, mu)
+                # Padding batches (m == 0) contribute zero gradient.
+                gsum = jax.tree_util.tree_map(
+                    lambda a, gg: a + (gg * m).astype(a.dtype), gsum, g
+                )
+            else:
+                st2, (loss, _acc) = step(st, x, y, correction, anchor, mu)
+            # Masked (padding) batches are exact no-ops.
+            keep = m > 0
+            st = jax.tree_util.tree_map(
+                lambda old, new: jnp.where(keep, new, old), st, st2
+            )
+            return (st, gsum), loss * m
+
+        def epoch_step(carry, _):
+            carry, losses = lax.scan(batch_step, carry, (xs, ys, bmask))
+            return carry, jnp.sum(losses) / jnp.maximum(jnp.sum(bmask), 1.0)
+
+        (state, gsum), epoch_losses = lax.scan(
+            epoch_step, (state, gsum0), None, length=epochs
+        )
+        return state.params, state.aux_state, epoch_losses[-1], gsum
+
+    return local_fit
+
+
+def build_batched_fit_program(
+    module: Any,
+    opt: Any,
+    loss_fn: Callable,
+    has_aux: bool,
+    track_grads: bool,
+    epochs: int,
+) -> Callable:
+    """The pool's compiled ``vmap(local_fit)`` over the stacked node
+    axis. The jit carries no explicit shardings: inputs placed by
+    :func:`maybe_nodes_mesh` + ``federation_sharding`` run sharded
+    (SPMD over the node axis), host-resident inputs run single-device
+    — one program either way."""
+    local_fit = build_masked_local_fit(
+        module, opt, loss_fn, has_aux, track_grads, epochs
+    )
+    return jax.jit(jax.vmap(local_fit), donate_argnums=(0, 1))

@@ -29,6 +29,7 @@ from tpfl.learning.dataset.tpfl_dataset import TpflDataset
 from tpfl.learning.learner import Learner
 from tpfl.learning.model import TpflModel
 from tpfl.parallel.federation import VmapFederation
+from tpfl.settings import Settings
 
 
 class FederationLearner(Learner):
@@ -83,10 +84,14 @@ class FederationLearner(Learner):
 
     def _ensure_fed(self) -> VmapFederation:
         if self._fed is None:
+            # No pinned mesh -> "auto": the engine spreads the local
+            # node axis over the host's chips when SHARD_NODES is on
+            # (a no-op on one device), so a multi-chip host's
+            # sub-federation runs sharded without configuration.
             self._fed = VmapFederation(
                 self.get_model().module,
                 self.n_local_nodes,
-                mesh=self.mesh,
+                mesh=self.mesh if self.mesh is not None else "auto",
                 learning_rate=self.learning_rate,
                 seed=self.seed,
             )
@@ -145,16 +150,25 @@ class FederationLearner(Learner):
         params = self._stack(model.get_parameters())
         aux = self._stack(model.aux_state) if model.aux_state else None
         rounds_run = 0
-        for _ in range(self.local_rounds):
+        # Local rounds run in device-side windows of
+        # SHARD_ROUNDS_PER_DISPATCH (engine fori_loop — one host
+        # dispatch RTT per window instead of per round); interrupts are
+        # honored between windows, which at the default window of 1 is
+        # exactly the legacy per-round granularity.
+        window = max(1, int(Settings.SHARD_ROUNDS_PER_DISPATCH))
+        while rounds_run < self.local_rounds:
             if self._interrupt.is_set():
                 break
+            k = min(window, self.local_rounds - rounds_run)
             if aux is not None:
-                params, aux, _losses = fed.round(
-                    params, xs, ys, epochs=self.epochs, aux=aux
+                params, aux, _losses = fed.run_rounds(
+                    params, xs, ys, epochs=self.epochs, aux=aux, n_rounds=k
                 )
             else:
-                params, _losses = fed.round(params, xs, ys, epochs=self.epochs)
-            rounds_run += 1
+                params, _losses = fed.run_rounds(
+                    params, xs, ys, epochs=self.epochs, n_rounds=k
+                )
+            rounds_run += k
         if rounds_run == 0:
             return self.skip_fit(model)
 

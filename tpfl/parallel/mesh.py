@@ -4,18 +4,30 @@ The scaling recipe (jax-ml.github.io/scaling-book): pick a mesh,
 annotate shardings, let XLA insert collectives. Axes used by tpfl:
 
 - ``nodes`` — the federation axis: logical FL nodes sharded over chips
-  (VmapFederation). Collectives over it ride ICI.
+  (FederationEngine / VmapFederation). Collectives over it ride ICI.
 - ``dp`` / ``fsdp`` — batch / parameter sharding inside one learner
   (ShardedTrainer).
+
+Node counts that do not divide the mesh are PADDED, not replicated:
+:func:`padded_node_count` rounds the node axis up to a multiple of the
+device count and :func:`pad_node_axis` / :func:`pad_node_weights` fill
+the tail with clone rows at zero FedAvg weight — the masked-mean fold
+already ignores w=0 entries exactly, so padding changes no numerics
+while every device keeps an equal shard. (Historically an indivisible
+node count silently fell back to a replicated single-device placement,
+throwing away the mesh.)
 """
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Any, Optional, Sequence
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+#: Canonical name of the federation axis.
+NODE_AXIS = "nodes"
 
 
 def create_mesh(
@@ -28,7 +40,7 @@ def create_mesh(
     multiply to the device count; a single -1 size is inferred.
     """
     devices = list(devices if devices is not None else jax.devices())
-    axes = dict(axes or {"nodes": len(devices)})
+    axes = dict(axes or {NODE_AXIS: len(devices)})
     sizes = list(axes.values())
     if sizes.count(-1) == 1:
         known = int(np.prod([s for s in sizes if s != -1]))
@@ -43,10 +55,93 @@ def create_mesh(
     return Mesh(dev_array, tuple(axes.keys()))
 
 
-def federation_sharding(mesh: Mesh, axis: str = "nodes") -> NamedSharding:
-    """Sharding for node-stacked pytrees: leading axis over the mesh."""
+def federation_sharding(mesh: Mesh, axis: str = NODE_AXIS) -> NamedSharding:
+    """Sharding for node-stacked pytrees: leading axis over the mesh.
+
+    The leading dimension must be a multiple of the mesh's ``axis``
+    size; round indivisible node counts up with
+    :func:`padded_node_count` + :func:`pad_node_axis` first (zero-weight
+    pad rows are exact no-ops under the masked-mean fold)."""
     return NamedSharding(mesh, PartitionSpec(axis))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
+
+
+def mesh_axis_size(mesh: Optional[Mesh], axis: str = NODE_AXIS) -> int:
+    """Size of ``axis`` on ``mesh`` (1 for no mesh / missing axis)."""
+    if mesh is None:
+        return 1
+    return int(mesh.shape.get(axis, 1))
+
+
+def padded_node_count(
+    n_nodes: int, mesh: Optional[Mesh], axis: str = NODE_AXIS
+) -> int:
+    """``n_nodes`` rounded up to a multiple of the mesh's ``axis`` size
+    — the stacked leading dimension that shards evenly. Equals
+    ``n_nodes`` when there is no mesh or it already divides."""
+    d = mesh_axis_size(mesh, axis)
+    return ((int(n_nodes) + d - 1) // d) * d
+
+
+def pad_node_axis(tree: Any, n_padded: int) -> Any:
+    """Pad every leaf's leading (node) axis to ``n_padded`` by cloning
+    row 0 — pad rows must be VALID model/data rows (training them is
+    well-defined), they are just excluded from the fold by their zero
+    weight. No-op when already at ``n_padded``."""
+    import jax.numpy as jnp
+
+    def pad(leaf: Any) -> Any:
+        leaf = jnp.asarray(leaf)
+        extra = n_padded - leaf.shape[0]
+        if extra <= 0:
+            return leaf
+        fill = jnp.broadcast_to(leaf[:1], (extra, *leaf.shape[1:]))
+        return jnp.concatenate([leaf, fill], axis=0)
+
+    return jax.tree_util.tree_map(pad, tree)
+
+
+def pad_node_weights(weights: Any, n_padded: int) -> Any:
+    """Pad a [N] (or per-round [R, N]) FedAvg weight vector with ZEROS
+    on the node axis — the masked-mean fold ignores w=0 entries, so pad
+    slots contribute nothing."""
+    import jax.numpy as jnp
+
+    w = jnp.asarray(weights, jnp.float32)
+    extra = n_padded - w.shape[-1]
+    if extra <= 0:
+        return w
+    pad_widths = [(0, 0)] * (w.ndim - 1) + [(0, extra)]
+    return jnp.pad(w, pad_widths)
+
+
+def valid_node_mask(n_nodes: int, n_padded: int) -> Any:
+    """[n_padded] float mask: 1.0 for real nodes, 0.0 for pad rows —
+    the uniform-fallback denominator when a round's weights are
+    all-zero (uniform over REAL nodes, never over padding)."""
+    import jax.numpy as jnp
+
+    return (jnp.arange(n_padded) < n_nodes).astype(jnp.float32)
+
+
+def shard_stacked(
+    mesh: Optional[Mesh],
+    tree: Any,
+    n_nodes: Optional[int] = None,
+    axis: str = NODE_AXIS,
+) -> Any:
+    """Place a node-stacked pytree on the mesh, padding the leading
+    axis to a device multiple first (``n_nodes`` defaults to the first
+    leaf's current leading size). With no mesh, returns the tree
+    unchanged."""
+    if mesh is None:
+        return tree
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return tree
+    n = int(n_nodes if n_nodes is not None else np.shape(leaves[0])[0])
+    tree = pad_node_axis(tree, padded_node_count(n, mesh, axis))
+    return jax.device_put(tree, federation_sharding(mesh, axis))

@@ -478,6 +478,38 @@ class Settings:
     exp_SAVE3.txt:213-223 uses 0.1). Bench/test machinery, not a
     production knob."""
 
+    # --- pod-scale federation engine (node-axis sharding) ---
+    SHARD_NODES: bool = False
+    """Master gate for automatic node-axis sharding in the federation
+    engine (tpfl.parallel.engine): when True and more than one
+    accelerator is visible, engine consumers that do not pin a mesh
+    explicitly — the batched-fit pool's vmapped chunks
+    (``engine.maybe_nodes_mesh``) and engines built with
+    ``mesh="auto"`` — spread the stacked node axis over a ``nodes``
+    mesh of the local devices, with the gossip exchange + FedAvg fold
+    lowered to ``lax.psum`` collectives over ICI. Off (default): one
+    device, the reference-parity layout. Determinism caveat: a FIXED
+    device count is part of the reproducibility key — same seed at the
+    same device count is byte-identical, but changing the device count
+    regroups the fold's partial sums (docs/scaling.md)."""
+
+    SHARD_DEVICES: int = 0
+    """Cap on the devices the SHARD_NODES mesh may span: 0 (default) =
+    all local devices, N > 0 = the first N. Lets a multi-tenant host
+    pin the federation to a slice of the chips."""
+
+    SHARD_ROUNDS_PER_DISPATCH: int = 1
+    """Federation rounds folded into ONE device dispatch by the
+    engine's ``lax.fori_loop`` round window
+    (``FederationEngine.run_rounds`` / ``FederationLearner``'s
+    local-round loop). Each host dispatch costs a full tunnel RTT
+    (~67 ms measured, BENCH_r05 ``dispatch_rtt_ms``) — the same order
+    as a whole sim1000 round — so windows of K rounds pay it once per
+    K. 1 (default) = one dispatch per round: bit-identical to the
+    legacy per-round path, and interrupts (a node told to stop
+    mid-fit) are honored at round granularity; larger windows are
+    interruptible only between windows."""
+
     # --- concurrency diagnostics ---
     LOCK_TRACING: bool = False
     """Opt-in runtime lock-order tracing (tpfl.concurrency): every lock
@@ -594,6 +626,13 @@ class Settings:
         cls.QUARANTINE_PROBATION_ROUNDS = 2
         cls.AGG_ROBUST_BUFFER = 64
         cls.ATTACK_NOISE_STD = 0.1
+        # Node-axis sharding off in tests: the suite's 8 virtual CPU
+        # devices share one host's cores, and single-dispatch rounds
+        # keep seeded runs bit-identical to the reference path. The
+        # engine tests opt in per-case with explicit meshes/windows.
+        cls.SHARD_NODES = False
+        cls.SHARD_DEVICES = 0
+        cls.SHARD_ROUNDS_PER_DISPATCH = 1
 
     @classmethod
     def set_standalone_settings(cls) -> None:
@@ -673,6 +712,11 @@ class Settings:
         cls.QUARANTINE_PROBATION_ROUNDS = 2
         cls.AGG_ROBUST_BUFFER = 64
         cls.ATTACK_NOISE_STD = 0.1
+        # Single-host handful-of-nodes parity profile: one device, one
+        # dispatch per round (reference behavior first).
+        cls.SHARD_NODES = False
+        cls.SHARD_DEVICES = 0
+        cls.SHARD_ROUNDS_PER_DISPATCH = 1
 
     @classmethod
     def set_scale_settings(cls) -> None:
@@ -794,6 +838,17 @@ class Settings:
         cls.QUARANTINE_PROBATION_ROUNDS = 2
         cls.AGG_ROBUST_BUFFER = 64
         cls.ATTACK_NOISE_STD = 0.1
+        # Scale is where the pod-scale engine earns its keep: spread
+        # the node axis over every visible chip (no-op on one device)
+        # and fold 8 rounds into each dispatch — at ~67 ms tunnel RTT
+        # and ~3 ms/round for the sim1000 shape, per-round dispatch is
+        # the dominant wall term the window removes. Trade-off: fit
+        # interrupts land between windows, and the arrival-order
+        # eager-fold caveat (AGG_STREAM_EAGER above) applies to
+        # cross-window reproducibility the same way.
+        cls.SHARD_NODES = True
+        cls.SHARD_DEVICES = 0
+        cls.SHARD_ROUNDS_PER_DISPATCH = 8
 
     @classmethod
     def snapshot(cls) -> dict[str, Any]:

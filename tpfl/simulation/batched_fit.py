@@ -14,6 +14,13 @@ order is shuffled once per round (not per epoch) because all epochs run
 inside the compiled program. Nodes with fewer batches than the group
 max are padded with masked no-op batches, so partitions of unequal size
 batch together exactly.
+
+The compiled program itself is built by the federation engine
+(``tpfl.parallel.engine.build_batched_fit_program`` — the one seam the
+vmapped federation, this pool, and the bench all ride), and when
+``Settings.SHARD_NODES`` is on with a multi-chip host the stacked node
+axis is placed over the ``nodes`` mesh
+(``engine.maybe_nodes_mesh``), so pool fits run SPMD across chips.
 """
 
 from __future__ import annotations
@@ -25,9 +32,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tpfl.learning.jax_learner import JaxLearner, TrainState, make_train_step
+from tpfl.learning.jax_learner import JaxLearner
 from tpfl.management import ledger, profiling
 from tpfl.management.logger import logger
+from tpfl.parallel.engine import build_batched_fit_program, maybe_nodes_mesh
+from tpfl.parallel.mesh import federation_sharding
 from tpfl.settings import Settings
 
 
@@ -77,50 +86,16 @@ class BatchedFitProgram:
         self._fns: dict[tuple[int, int], Callable] = {}
 
     def _build(self, epochs: int) -> Callable:
-        module, opt, loss_fn = self._module, self._opt, self._loss_fn
-        track = self._track
-        step = make_train_step(module, loss_fn, self._has_aux, with_grads=track)
-
-        def local_fit(params, aux, correction, anchor, mu, xs, ys, bmask):
-            state = TrainState.create(
-                apply_fn=None, params=params, tx=opt, aux_state=aux
-            )
-            gsum0 = jax.tree_util.tree_map(
-                lambda p: jnp.zeros(
-                    p.shape, jnp.promote_types(p.dtype, jnp.float32)
-                ),
-                state.params,
-            ) if track else jnp.float32(0)
-
-            def batch_step(carry, batch):
-                st, gsum = carry
-                x, y, m = batch
-                if track:
-                    st2, (loss, _acc, g) = step(st, x, y, correction, anchor, mu)
-                    # Padding batches (m == 0) contribute zero gradient.
-                    gsum = jax.tree_util.tree_map(
-                        lambda a, gg: a + (gg * m).astype(a.dtype), gsum, g
-                    )
-                else:
-                    st2, (loss, _acc) = step(st, x, y, correction, anchor, mu)
-                # Masked (padding) batches are exact no-ops.
-                keep = m > 0
-                st = jax.tree_util.tree_map(
-                    lambda old, new: jnp.where(keep, new, old), st, st2
-                )
-                return (st, gsum), loss * m
-
-            def epoch_step(carry, _):
-                carry, losses = jax.lax.scan(batch_step, carry, (xs, ys, bmask))
-                return carry, jnp.sum(losses) / jnp.maximum(jnp.sum(bmask), 1.0)
-
-            (state, gsum), epoch_losses = jax.lax.scan(
-                epoch_step, (state, gsum0), None, length=epochs
-            )
-            return state.params, state.aux_state, epoch_losses[-1], gsum
-
-        return jax.jit(
-            jax.vmap(local_fit), donate_argnums=(0, 1)
+        # The program is the engine's masked vmapped local fit — ONE
+        # builder shared with the pod-scale federation seam, so the
+        # pool and the sharded engine can never drift numerically.
+        return build_batched_fit_program(
+            self._module,
+            self._opt,
+            self._loss_fn,
+            self._has_aux,
+            self._track,
+            epochs,
         )
 
     def run(
@@ -289,6 +264,27 @@ def _run_chunk(prog: BatchedFitProgram, learners: list[JaxLearner]) -> None:
     stacked_aux = _stack(aux_trees)
     stacked_corr = _stack(corr_trees)
     stacked_anchor = _stack(anchor_trees)
+    xs_s: Any = np.stack(xs_l)
+    ys_s: Any = np.stack(ys_l)
+    mask_s: Any = np.stack(mask_l)
+    mus_s: Any = np.asarray(mus, np.float32)
+
+    # Pod-scale path (Settings.SHARD_NODES): place the stacked node
+    # axis over the local `nodes` mesh — the pow-2 bucket above divides
+    # a 2^k-chip host, so every chip trains an equal shard of the
+    # chunk's nodes SPMD inside the one compiled program.
+    mesh = maybe_nodes_mesh(bucket)
+    if mesh is not None:
+        sharding = federation_sharding(mesh)
+        stacked_params, stacked_aux, stacked_corr, stacked_anchor = (
+            jax.device_put(t, sharding)
+            for t in (stacked_params, stacked_aux, stacked_corr, stacked_anchor)
+        )
+        xs_s, ys_s, mask_s = (
+            jax.device_put(jnp.asarray(a), sharding)
+            for a in (xs_s, ys_s, mask_s)
+        )
+        mus_s = jax.device_put(jnp.asarray(mus_s), sharding)
 
     # Round attribution: the chunk's dispatch gap and device compute
     # are charged to EVERY participating node — each node's round
@@ -300,10 +296,10 @@ def _run_chunk(prog: BatchedFitProgram, learners: list[JaxLearner]) -> None:
         stacked_aux,
         stacked_corr,
         stacked_anchor,
-        np.asarray(mus, np.float32),
-        np.stack(xs_l),
-        np.stack(ys_l),
-        np.stack(mask_l),
+        mus_s,
+        xs_s,
+        ys_s,
+        mask_s,
         epochs,
     )
     if prof:
