@@ -73,6 +73,15 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
   under AGGREGATION_TIMEOUT — quorum degradation) and final loss (must
   land within 5% of fault-free).
 
+- extra.async_*: asynchronous buffered rounds tier
+  (stages.AsyncRoundStage / Settings.ASYNC_ROUNDS) — async_ab runs the
+  seeded 10-node digits federation under a TrainerSpeedPlan with a
+  10x-slower 20% tail, sync-vs-async: async must beat the barrier'd
+  sync lifecycle by >=1.5x rounds/sec at steady loss within 2%;
+  async_determinism runs the SERIALIZED discipline (plan-seeded
+  AsyncSchedule reorder buffers) twice with one seed and asserts
+  byte-identical final global models across runs and across nodes.
+
 - extra.profiling_*: device-plane observatory tier
   (management/profiling.py) — CompileObservatory recompile detection on
   a shape-churn probe, a seeded 4-node digits A/B with
@@ -814,7 +823,7 @@ def _telemetry_tier(extra: dict) -> None:
 TIERS = (
     "primary", "resnet", "attention", "transformer", "sim1000",
     "multichip", "wire", "serde", "chaos", "analysis", "telemetry",
-    "profiling", "ledger", "byzantine",
+    "profiling", "ledger", "byzantine", "async",
 )
 
 
@@ -1417,6 +1426,158 @@ def _byzantine_tier(extra: dict) -> None:
         extra["byzantine_error"] = str(e)[:200]
 
 
+def _async_tier(extra: dict) -> None:
+    """Asynchronous buffered rounds tier (stages.AsyncRoundStage +
+    Aggregator async_k buffers + communication/faults.AsyncSchedule).
+    Two reports:
+
+    - extra.async_ab: a seeded 10-node digits federation under a
+      TrainerSpeedPlan with a 10x-slower 20% tail — the exact fleet
+      shape the synchronous barrier is worst at. The sync arm (vote
+      lifecycle, full coverage) pays the slow trainers' fit time every
+      round; the async arm (free-running FedBuff buffers, K=8) closes
+      each round on the first 8 contributors and folds the stragglers
+      later at staleness-discounted weight. Gates: async rounds/sec
+      >= 1.5x sync, and async steady loss within 2% of sync.
+    - extra.async_determinism: two same-seed SERIALIZED async runs
+      (test profile discipline — the plan-seeded AsyncSchedule reorder
+      buffer at every aggregator) must end with byte-identical global
+      models, both across the two runs and across every node within a
+      run (the fold sequence is position-deterministic, so all nodes
+      converge on identical bytes).
+    """
+    from tpfl.settings import Settings
+
+    try:
+        snap = Settings.snapshot()
+        try:
+            from tpfl.attacks import metric_table, run_seeded_experiment
+            from tpfl.attacks.harness import final_model_digests
+            from tpfl.communication.faults import TrainerSpeedPlan
+            from tpfl.management.logger import logger as _logger
+
+            Settings.set_test_settings()
+            Settings.LOG_LEVEL = "ERROR"
+            _logger.set_level("ERROR")
+            seed = 3131
+            n = 10
+            Settings.ELECTION = "hash"
+            Settings.TRAIN_SET_SIZE = n
+            # The async stage hints the pool with ASYNC_BUFFER_K so the
+            # synchronized-fast fits co-batch; cap how long a partial
+            # group may hold (the 5 s default would let the pool
+            # rebuild the barrier the lifecycle removed). Same knob in
+            # both arms — the sync arm's full groups never wait it out.
+            Settings.SIM_BATCH_MAX_WAIT = 0.6
+
+            def speed_plan() -> TrainerSpeedPlan:
+                # 2 of 10 trainers 10x slower — seeded, address-pinned.
+                return TrainerSpeedPlan.skewed(
+                    [f"seed{seed}-n{i}" for i in range(n)],
+                    slow_frac=0.2, base_delay=0.25, skew=10.0, seed=seed,
+                )
+
+            def mean_loss(exp: str) -> float:
+                tbl = metric_table(exp)
+                vals = [
+                    tbl[node]["test_loss"][-1][1]
+                    for node in sorted(tbl)
+                    if tbl[node].get("test_loss")
+                ]
+                return float(sum(vals) / max(len(vals), 1))
+
+            def run_arm(async_mode: bool, rounds: int) -> "tuple[float, float, str]":
+                Settings.ASYNC_ROUNDS = async_mode
+                # K well below the fleet: a buffer that needs a
+                # contribution from every fast trainer is still a
+                # barrier over the fast set (measured: K=8 of 8 fast
+                # pinned speedup at ~1x; K=5 rides the first five
+                # arrivals at better-than-sync steady loss).
+                Settings.ASYNC_BUFFER_K = 5
+                # Throughput arm runs FREE-RUNNING (the scale-profile
+                # configuration): eager arrival-order folds, no
+                # schedule — the determinism arm below exercises the
+                # serialized discipline separately.
+                Settings.ASYNC_SERIALIZED = False
+                t0 = time.monotonic()
+                exp = run_seeded_experiment(
+                    seed, n, rounds, epochs=2,
+                    speed_plan=speed_plan(),
+                    samples_per_node=100, batch_size=25, timeout=600.0,
+                )
+                elapsed = time.monotonic() - t0
+                return rounds / max(elapsed, 1e-9), mean_loss(exp), exp
+
+            # Warm arm (compile) at the smallest useful size, then the
+            # measured arms. The slow tail costs the SYNC arm ~1.2 s
+            # per round; async closes on the fast 8.
+            run_arm(True, 2)
+            sync_rounds, async_rounds = 5, 10
+            sync_rps, sync_loss, _ = run_arm(False, sync_rounds)
+            async_rps, async_loss, _ = run_arm(True, async_rounds)
+            speedup = async_rps / max(sync_rps, 1e-9)
+            loss_ratio = async_loss / max(sync_loss, 1e-9)
+            extra["async_ab"] = {
+                "seed": seed,
+                "nodes": n,
+                "skew": "20% of trainers 10x slower (TrainerSpeedPlan)",
+                "buffer_k": 5,
+                "sync": {
+                    "rounds": sync_rounds,
+                    "rounds_per_s": round(sync_rps, 3),
+                    "steady_loss": round(sync_loss, 4),
+                },
+                "async": {
+                    "rounds": async_rounds,
+                    "rounds_per_s": round(async_rps, 3),
+                    "steady_loss": round(async_loss, 4),
+                },
+                "speedup": round(speedup, 3),
+                "loss_ratio": round(loss_ratio, 4),
+                "loss_within_2pct": bool(loss_ratio <= 1.02),
+                "beats_sync_1_5x": bool(speedup >= 1.5),
+            }
+
+            # Same-seed byte-determinism under the serialized
+            # discipline (test-profile configuration): the plan-seeded
+            # AsyncSchedule makes every aggregator admit the identical
+            # global contribution sequence, so the staleness-weighted
+            # folds produce identical bytes at every node and in every
+            # run.
+            def run_det() -> "dict[str, str]":
+                Settings.ASYNC_ROUNDS = True
+                Settings.ASYNC_BUFFER_K = 8
+                Settings.ASYNC_SERIALIZED = True
+                # Bit-exactness needs FIXED program shapes: the
+                # batching pool's vmap bucket width follows whoever
+                # co-submits (timing-dependent), and XLA compiles a
+                # different reduction order per width. Inline learners
+                # give every fit its own fixed-shape program — the
+                # same rule the engine's byte-determinism contract
+                # states (fixed device count / fixed shapes).
+                Settings.DISABLE_SIMULATION = True
+                exp = run_seeded_experiment(
+                    seed, n, 4, epochs=2,
+                    speed_plan=speed_plan(),
+                    samples_per_node=100, batch_size=25, timeout=600.0,
+                )
+                return final_model_digests(exp)
+
+            d1, d2 = run_det(), run_det()
+            extra["async_determinism"] = {
+                "byte_identical": bool(
+                    d1 == d2 and len(set(d1.values())) == 1
+                ),
+                "runs_match": bool(d1 == d2),
+                "nodes_converged_identical": len(set(d1.values())) == 1,
+                "digest": sorted(set(d1.values()))[:1],
+            }
+        finally:
+            Settings.restore(snap)
+    except Exception as e:
+        extra["async_error"] = str(e)[:200]
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument(
@@ -1570,32 +1731,26 @@ def main() -> None:
         # Device-side timing: K rounds per dispatch inside one
         # fori_loop — a dispatch+sync round trip costs ~100 ms here
         # (tunneled TPU), same order as a round, so host-loop timing
-        # misattributes it.
-        if fed._round_fn is None:
-            fed._round_fn = fed._build_round()
+        # misattributes it. Since PR 9 the multi-round window is
+        # FRAMEWORK API (`FederationEngine.run_rounds` — the same
+        # program `FederationLearner` dispatches per
+        # SHARD_ROUNDS_PER_DISPATCH window); the tier now drives that
+        # seam instead of a bench-local fori_loop, so the measured
+        # number IS the framework path, engine overhead included
+        # (docs/perf_cnn.md round 7). donate=False: best_of_wall
+        # re-feeds the same input buffers.
         w_ones = jnp.ones((n_nodes,), jnp.float32)
-        round_fn = fed._round_fn
         R_INNER = 20
 
-        from jax import lax
-
-        @jax.jit
-        def run_rounds(p, xs, ys, w):
-            # xs/ys/w are ARGUMENTS, not closed-over — closure would
-            # embed the 150+ MB batch arrays as program constants (the
-            # remote compile service rejects the request body).
-            def body(i, carry):
-                p, _ = carry
-                p2, losses = round_fn(p, xs, ys, w, epochs)
-                return p2, losses
-
-            return lax.fori_loop(
-                0, R_INNER, body, (p, jnp.zeros((n_nodes,), jnp.float32))
+        def run_window(p, xs, ys, w):
+            return fed.run_rounds(
+                p, xs, ys, weights=w, epochs=epochs, n_rounds=R_INNER,
+                donate=False,
             )
 
         with profiling.maybe_trace(args.profile):
             total, (params, losses) = profiling.best_of_wall(
-                run_rounds, (params, xs, ys, w_ones)
+                run_window, (params, xs, ys, w_ones)
             )
         per_round = max(total - rtt, 1e-9) / R_INNER
         rounds_per_sec = 1.0 / per_round
@@ -2114,6 +2269,13 @@ def main() -> None:
 
     if "byzantine" in tiers:
         _byzantine_tier(extra)
+
+    # Async tier: FedBuff-style buffered rounds vs the synchronous
+    # barrier under a 10x-skewed trainer fleet, plus the serialized
+    # same-seed byte-determinism receipt
+    # (extra.async_ab / extra.async_determinism).
+    if "async" in tiers:
+        _async_tier(extra)
 
     # multichip runs LAST: its 8-virtual-device subprocess and big
     # stacked allocations must not perturb the budget-sensitive

@@ -1,0 +1,453 @@
+"""Asynchronous buffered rounds (FedBuff-style, Settings.ASYNC_ROUNDS):
+staleness weighting, buffer-full / deadline close semantics, the
+serialized AsyncSchedule discipline, quarantine-vs-buffer accounting,
+and the async round lifecycle e2e (incl. the same-seed byte-determinism
+receipt)."""
+
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpfl.communication.faults import AsyncSchedule, TrainerSpeedPlan
+from tpfl.learning.aggregators import FedAvg
+from tpfl.learning.aggregators.aggregator import staleness_weight
+from tpfl.learning.model import TpflModel
+from tpfl.settings import Settings
+
+
+def mk_model(value, n_samples, contributors):
+    params = {
+        "w": jnp.full((3, 3), float(value), jnp.float32),
+        "b": jnp.full((3,), float(value), jnp.float32),
+    }
+    return TpflModel(
+        params=params, num_samples=n_samples, contributors=contributors
+    )
+
+
+def leaf_value(model):
+    return float(np.asarray(model.get_parameters()["w"])[0, 0])
+
+
+# --- staleness weight math -------------------------------------------------
+
+
+def test_staleness_weight_curve():
+    Settings.ASYNC_STALENESS_EXP = 0.5
+    assert staleness_weight(0) == 1.0
+    assert staleness_weight(-3) == 1.0  # clamped: the future is fresh
+    assert staleness_weight(3) == pytest.approx((1 + 3) ** -0.5)
+    # exp=0 disables discounting entirely.
+    Settings.ASYNC_STALENESS_EXP = 0.0
+    assert staleness_weight(100) == 1.0
+    Settings.ASYNC_STALENESS_EXP = 0.5
+
+
+def test_version_zero_contribution_against_far_advanced_model():
+    """A contribution still trained from version 0 folding into round
+    100 is discounted to near-nothing — but never to zero, and never
+    NaN."""
+    Settings.ASYNC_STALENESS_EXP = 0.5
+    agg = FedAvg("n")
+    agg.set_nodes_to_aggregate(["a", "b"], async_k=2, round_ordinal=100)
+    agg.add_model(mk_model(0.0, 100, ["a"]), start_version=100)  # fresh
+    agg.add_model(mk_model(10.0, 100, ["b"]), start_version=0)  # ancient
+    out = agg.wait_and_get_aggregation(timeout=1.0)
+    w_stale = staleness_weight(100)
+    expected = (0.0 * 1.0 + 10.0 * w_stale) / (1.0 + w_stale)
+    assert leaf_value(out) == pytest.approx(expected, rel=1e-5)
+    assert 0.0 < leaf_value(out) < 1.0  # discounted hard, not erased
+    agg.clear()
+
+
+def test_staleness_weighted_fold_exact():
+    """Two contributions one version apart: the close-time serialized
+    fold must weight them num_samples * w(tau) exactly."""
+    Settings.ASYNC_STALENESS_EXP = 0.5
+    agg = FedAvg("n")
+    agg.set_nodes_to_aggregate(["a", "b"], async_k=2, round_ordinal=5)
+    agg.add_model(mk_model(2.0, 50, ["a"]), start_version=5)
+    agg.add_model(mk_model(4.0, 50, ["b"]), start_version=4)
+    out = agg.wait_and_get_aggregation(timeout=1.0)
+    w1 = 50 * staleness_weight(0)
+    w2 = 50 * staleness_weight(1)
+    assert leaf_value(out) == pytest.approx(
+        (2.0 * w1 + 4.0 * w2) / (w1 + w2), rel=1e-5
+    )
+    agg.clear()
+
+
+def test_untagged_contribution_is_fresh():
+    """No start_version tag (sync payloads, pre-async peers) folds at
+    staleness 0 — full weight."""
+    agg = FedAvg("n")
+    agg.set_nodes_to_aggregate(["a", "b"], async_k=2, round_ordinal=50)
+    agg.add_model(mk_model(1.0, 10, ["a"]))
+    agg.add_model(mk_model(3.0, 10, ["b"]), start_version=50)
+    out = agg.wait_and_get_aggregation(timeout=1.0)
+    assert leaf_value(out) == pytest.approx(2.0, rel=1e-5)
+    agg.clear()
+
+
+# --- buffer close semantics ------------------------------------------------
+
+
+def test_buffer_full_closes_without_full_coverage():
+    agg = FedAvg("n")
+    agg.set_nodes_to_aggregate(
+        [f"p{i}" for i in range(10)], async_k=3, round_ordinal=0
+    )
+    agg.add_model(mk_model(1.0, 10, ["p0"]), start_version=0)
+    agg.add_model(mk_model(1.0, 10, ["p1"]), start_version=0)
+    assert agg.is_open()
+    agg.add_model(mk_model(1.0, 10, ["p2"]), start_version=0)
+    assert not agg.is_open()
+    assert agg.close_reason() == "buffer_full"
+    agg.clear()
+
+
+def test_buffer_k1_degenerate():
+    """K=1: every single contribution makes a round."""
+    agg = FedAvg("n")
+    agg.set_nodes_to_aggregate(["a", "b"], async_k=1, round_ordinal=0)
+    assert agg.is_open()
+    covered = agg.add_model(mk_model(7.0, 10, ["b"]), start_version=0)
+    assert covered == ["b"]
+    assert not agg.is_open()
+    out = agg.wait_and_get_aggregation(timeout=1.0)
+    assert leaf_value(out) == pytest.approx(7.0)
+    assert out.get_contributors() == ["b"]
+    agg.clear()
+
+
+def test_async_k_clamped_to_train_set():
+    agg = FedAvg("n")
+    agg.set_nodes_to_aggregate(["a", "b"], async_k=64, round_ordinal=0)
+    agg.add_model(mk_model(1.0, 10, ["a"]), start_version=0)
+    assert agg.is_open()
+    agg.add_model(mk_model(1.0, 10, ["b"]), start_version=0)
+    assert not agg.is_open()
+    agg.clear()
+
+
+def test_unknown_contributor_grows_async_train_set():
+    """Async rounds have no elected set to police: a late joiner's
+    contribution folds instead of being dropped."""
+    agg = FedAvg("n")
+    agg.set_nodes_to_aggregate(["a", "b"], async_k=2, round_ordinal=0)
+    covered = agg.add_model(mk_model(1.0, 10, ["z"]), start_version=0)
+    assert covered == ["z"]
+    agg.clear()
+
+
+def test_deadline_with_empty_buffer_fails_open_loudly():
+    """The deadline on an EMPTY buffer must not close the round (there
+    is nothing to aggregate) — it fails open: round stays open, the
+    event/counter still fire, the caller re-arms."""
+    from tpfl.management.logger import logger
+
+    agg = FedAvg("n")
+    agg.set_nodes_to_aggregate(["a", "b", "c"], async_k=3, round_ordinal=0)
+    before = _deadline_count("n")
+    assert agg.async_deadline_close() is False
+    assert agg.is_open()
+    assert agg.close_reason() is None
+    assert _deadline_count("n") == before + 1  # loud, not silent
+    # A contribution later still folds and the deadline then closes.
+    agg.add_model(mk_model(3.0, 10, ["a"]), start_version=0)
+    assert agg.async_deadline_close() is True
+    assert agg.close_reason() == "deadline"
+    out = agg.wait_and_get_aggregation(timeout=1.0)
+    assert leaf_value(out) == pytest.approx(3.0)
+    agg.clear()
+    _ = logger  # imported for parity with the intake's logging path
+
+
+def _deadline_count(node: str) -> float:
+    from tpfl.management.logger import logger
+
+    folded = logger.metrics.fold()
+    total = 0.0
+    for (name, labels), v in folded["counters"].items():
+        if name == "tpfl_agg_deadline_total" and dict(labels).get("node") == node:
+            total += v
+    return total
+
+
+def test_deadline_close_is_noop_for_sync_rounds():
+    agg = FedAvg("n")
+    agg.set_nodes_to_aggregate(["a", "b"])  # synchronous round
+    assert agg.async_deadline_close() is False
+    assert agg.is_open()
+    agg.clear()
+
+
+def test_remove_dead_nodes_noop_in_async():
+    agg = FedAvg("n")
+    agg.set_nodes_to_aggregate(["a", "b", "c"], async_k=2, round_ordinal=0)
+    assert agg.remove_dead_nodes(["b"]) is False
+    # The expected set did not shrink: b's later contribution folds.
+    covered = agg.add_model(mk_model(1.0, 10, ["b"]), start_version=0)
+    assert covered == ["b"]
+    agg.clear()
+
+
+# --- quarantine x buffer accounting ---------------------------------------
+
+
+def test_quarantined_contribution_fills_buffer_but_not_fold():
+    """An excluded (quarantined) contribution still occupies a buffer
+    slot — coverage accounting — but its params never reach the
+    weighted mean; fail-open applies when the verdicts empty the fold
+    entirely."""
+    from tpfl.management import ledger
+    from tpfl.management.quarantine import QuarantineEngine
+
+    Settings.QUARANTINE_ENABLED = True
+    Settings.LEDGER_ENABLED = True
+    ledger.contrib.reset()
+    try:
+        eng = QuarantineEngine("n")
+        agg = FedAvg("n")
+        agg.set_quarantine(eng)
+        ref = mk_model(1.0, 1, ["ref"]).get_parameters()
+        agg.set_nodes_to_aggregate(
+            ["good", "evil", "late"], async_k=2, round_ordinal=0
+        )
+        ledger.contrib.open_round("n", 0, ref)
+        agg.add_model(mk_model(1.0, 10, ["good"]), start_version=0)
+        # Sign-flipped: flagged at intake, excluded from the fold, but
+        # its slot still closes the K=2 buffer.
+        agg.add_model(mk_model(-1.0, 10, ["evil"]), start_version=0)
+        assert not agg.is_open()
+        assert agg.close_reason() == "buffer_full"
+        out = agg.wait_and_get_aggregation(timeout=1.0)
+        # Fold = the one clean contribution; the excluded peer rides
+        # as a coverage-only passenger in the contributor metadata.
+        assert leaf_value(out) == pytest.approx(1.0)
+        assert sorted(out.get_contributors()) == ["evil", "good"]
+        assert out.get_num_samples() == 10
+        agg.clear()
+        ledger.contrib.close_round("n")
+    finally:
+        ledger.contrib.reset()
+        Settings.QUARANTINE_ENABLED = False
+        Settings.LEDGER_ENABLED = False
+
+
+def test_all_quarantined_buffer_fails_open():
+    from tpfl.management import ledger
+    from tpfl.management.quarantine import QuarantineEngine
+
+    Settings.QUARANTINE_ENABLED = True
+    Settings.LEDGER_ENABLED = True
+    ledger.contrib.reset()
+    try:
+        eng = QuarantineEngine("n")
+        agg = FedAvg("n")
+        agg.set_quarantine(eng)
+        ref = mk_model(1.0, 1, ["ref"]).get_parameters()
+        agg.set_nodes_to_aggregate(
+            ["e1", "e2"], async_k=2, round_ordinal=0
+        )
+        ledger.contrib.open_round("n", 0, ref)
+        agg.add_model(mk_model(-1.0, 10, ["e1"]), start_version=0)
+        agg.add_model(mk_model(-2.0, 10, ["e2"]), start_version=0)
+        assert not agg.is_open()
+        out = agg.wait_and_get_aggregation(timeout=1.0)
+        # Every buffered contribution was excluded: fail OPEN to the
+        # undefended staleness-weighted fold, never brick the round.
+        assert leaf_value(out) == pytest.approx(-1.5)
+        agg.clear()
+        ledger.contrib.close_round("n")
+    finally:
+        ledger.contrib.reset()
+        Settings.QUARANTINE_ENABLED = False
+        Settings.LEDGER_ENABLED = False
+
+
+def test_ledger_entry_carries_staleness_ordinal():
+    from tpfl.management import ledger
+
+    Settings.LEDGER_ENABLED = True
+    ledger.contrib.reset()
+    try:
+        agg = FedAvg("n")
+        ref = mk_model(1.0, 1, ["ref"]).get_parameters()
+        agg.set_nodes_to_aggregate(["a"], async_k=1, round_ordinal=7)
+        ledger.contrib.open_round("n", 7, ref)
+        agg.add_model(mk_model(2.0, 10, ["a"]), start_version=4)
+        entries = [
+            e for e in ledger.contrib.entries("n") if e["peer"] == "a"
+        ]
+        assert entries, "contribution must be recorded"
+        assert entries[-1]["staleness"] == 3
+        assert entries[-1]["version"] == 4  # round 7 - staleness 3
+        agg.clear()
+        ledger.contrib.close_round("n")
+    finally:
+        ledger.contrib.reset()
+        Settings.LEDGER_ENABLED = False
+
+
+# --- the seeded scheduler discipline --------------------------------------
+
+
+def test_speed_plan_skewed_deterministic():
+    addrs = [f"n{i}" for i in range(10)]
+    p1 = TrainerSpeedPlan.skewed(addrs, slow_frac=0.2, seed=7)
+    p2 = TrainerSpeedPlan.skewed(addrs, slow_frac=0.2, seed=7)
+    assert p1.delays == p2.delays
+    slow = [a for a, d in p1.delays.items() if d > p1.delays[min(p1.delays, key=p1.delays.get)]]
+    assert len(slow) == 2
+    assert TrainerSpeedPlan.skewed(addrs, slow_frac=0.2, seed=8).delays != p1.delays
+
+
+def test_async_schedule_fork_identical_order():
+    plan = TrainerSpeedPlan.skewed(
+        [f"n{i}" for i in range(5)], slow_frac=0.2, seed=11
+    )
+    s1 = AsyncSchedule.for_plan(plan)
+    s2 = s1.fork()
+    seq1, seq2 = [], []
+    for _ in range(50):
+        seq1.append(s1.expected())
+        s1.advance()
+        seq2.append(s2.expected())
+        s2.advance()
+    assert seq1 == seq2
+    # Slow trainers appear least often — the schedule mirrors speeds.
+    slow = max(plan.delays, key=plan.delays.get)
+    fast = min(plan.delays, key=plan.delays.get)
+    assert seq1.count(slow) < seq1.count(fast)
+
+
+def test_schedule_reorder_buffer_admits_in_schedule_order():
+    """Out-of-schedule arrivals hold; the schedule head's arrival
+    drains everything admissible, in order."""
+    sched = AsyncSchedule({"a": 1.0, "b": 1.0, "c": 1.0}, seed=3)
+    agg = FedAvg("n")
+    agg.set_async_schedule(sched.fork())
+    agg.set_nodes_to_aggregate(["a", "b", "c"], async_k=3, round_ordinal=0)
+    order = []
+    probe = sched.fork()
+    for _ in range(3):
+        order.append(probe.expected())
+        probe.advance()
+    # Deliver in REVERSE schedule order: nothing folds until the head
+    # arrives, then the drain admits all three.
+    last, mid, head = order[2], order[1], order[0]
+    agg.add_model(mk_model(1.0, 10, [last]), start_version=0)
+    assert agg.get_aggregated_models() == []
+    agg.add_model(mk_model(1.0, 10, [mid]), start_version=0)
+    assert agg.get_aggregated_models() == []
+    agg.add_model(mk_model(1.0, 10, [head]), start_version=0)
+    assert sorted(agg.get_aggregated_models()) == sorted(order)
+    assert not agg.is_open()
+    agg.clear()
+
+
+def test_schedule_hold_survives_round_boundary():
+    """A contribution held past its round (its schedule slot not yet
+    reached) admits into the NEXT round after reopen."""
+    sched = AsyncSchedule({"a": 1.0, "b": 1.0}, seed=5)
+    agg = FedAvg("n")
+    agg.set_async_schedule(sched.fork())
+    agg.set_nodes_to_aggregate(["a", "b"], async_k=1, round_ordinal=0)
+    probe = sched.fork()
+    head = probe.expected()
+    other = "b" if head == "a" else "a"
+    # The non-head arrival holds; the head closes the K=1 round.
+    agg.add_model(mk_model(2.0, 10, [other]), start_version=0)
+    agg.add_model(mk_model(1.0, 10, [head]), start_version=0)
+    assert not agg.is_open()
+    agg.wait_and_get_aggregation(timeout=1.0)
+    agg.clear()
+    # Reopen: the held contribution admits at its slot.
+    agg.set_nodes_to_aggregate(["a", "b"], async_k=1, round_ordinal=1)
+    assert agg.get_aggregated_models() == [other]
+    assert not agg.is_open()
+    agg.clear()
+
+
+# --- lifecycle e2e ---------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_async_federation_e2e_learns():
+    """4-node async federation: rounds complete, nobody stalls, the
+    model improves over the init."""
+    from tpfl.attacks import metric_table, run_seeded_experiment
+
+    Settings.ASYNC_ROUNDS = True
+    Settings.ASYNC_BUFFER_K = 3
+    Settings.ASYNC_SERIALIZED = True
+    exp = run_seeded_experiment(
+        97, 4, 5, epochs=3, samples_per_node=100, batch_size=20,
+        timeout=180.0,
+    )
+    tbl = metric_table(exp)
+    assert len(tbl) == 4
+    accs = [tbl[n]["test_metric"][-1][1] for n in sorted(tbl)]
+    assert sum(accs) / len(accs) > 0.25  # well above the 0.1 random floor
+
+
+@pytest.mark.slow
+def test_async_serialized_same_seed_byte_identical():
+    """The determinism receipt at test scale: two same-seed serialized
+    runs (inline learners — fixed program shapes) end byte-identical,
+    across runs AND across nodes within a run."""
+    from tpfl.attacks import run_seeded_experiment
+    from tpfl.attacks.harness import final_model_digests
+
+    Settings.ASYNC_ROUNDS = True
+    Settings.ASYNC_BUFFER_K = 2
+    Settings.ASYNC_SERIALIZED = True
+    Settings.DISABLE_SIMULATION = True
+
+    def run():
+        plan = TrainerSpeedPlan.skewed(
+            [f"seed131-n{i}" for i in range(3)],
+            slow_frac=0.34, base_delay=0.05, skew=5.0, seed=131,
+        )
+        exp = run_seeded_experiment(
+            131, 3, 3, epochs=1, speed_plan=plan,
+            samples_per_node=60, batch_size=20, timeout=180.0,
+        )
+        return final_model_digests(exp)
+
+    d1, d2 = run(), run()
+    assert d1 == d2
+    assert len(set(d1.values())) == 1
+
+
+@pytest.mark.slow
+def test_async_free_running_trainer_loop_shuts_down():
+    """Free-running mode: the decoupled trainer threads drain at
+    experiment end (a daemon thread parked in an XLA dispatch at
+    interpreter teardown aborts the process)."""
+    from tpfl.attacks import run_seeded_experiment
+
+    Settings.ASYNC_ROUNDS = True
+    Settings.ASYNC_BUFFER_K = 2
+    Settings.ASYNC_SERIALIZED = False
+    run_seeded_experiment(
+        53, 3, 3, epochs=1, samples_per_node=60, batch_size=20,
+        timeout=180.0,
+    )
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        loops = [
+            t for t in threading.enumerate()
+            if t.name.startswith("async-trainer-")
+        ]
+        if not loops:
+            break
+        time.sleep(0.1)
+    assert not [
+        t for t in threading.enumerate()
+        if t.name.startswith("async-trainer-") and t.is_alive()
+    ]

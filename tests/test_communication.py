@@ -424,6 +424,7 @@ def test_full_model_relay_on_first_adoption():
     state.relay_lock = threading.Lock()
     state.last_relayed_round = -1
     state.model_version = 0
+    state.model_round_origin = 0
     # The relay reads neighbor status through the snapshot accessor
     # (nei_status is nei_status_lock-guarded on the real NodeState).
     state.get_nei_status = lambda: dict(state.nei_status)

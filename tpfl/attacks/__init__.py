@@ -43,8 +43,10 @@ from tpfl.attacks.plan import (
     AttackPlan,
     AttackSpec,
     PlannedAdversary,
+    SlowLearner,
     apply_attack_plan,
     apply_chaos,
+    apply_speed_plan,
 )
 
 __all__ = [
@@ -56,8 +58,10 @@ __all__ = [
     "AttackPlan",
     "AttackSpec",
     "PlannedAdversary",
+    "SlowLearner",
     "apply_attack_plan",
     "apply_chaos",
+    "apply_speed_plan",
     "run_seeded_experiment",
     "adversary_map",
     "metric_table",

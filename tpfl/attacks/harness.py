@@ -28,6 +28,18 @@ def adversary_map(exp_name: str) -> dict[str, str]:
     """``{node addr: attack name}`` for a harness-run experiment
     (empty for fault-free runs / unknown experiments)."""
     return dict(_ADVERSARIES.get(exp_name, {}))
+
+
+#: Final-model digests per experiment: ``exp_name -> {addr: sha256}``
+#: of every node's parameter leaves at finish — the byte-determinism
+#: receipt the async bench tier compares across same-seed runs (and
+#: across nodes within one serialized run).
+_FINAL_DIGESTS: dict[str, dict[str, str]] = {}
+
+
+def final_model_digests(exp_name: str) -> dict[str, str]:
+    """``{addr: sha256(params)}`` captured at experiment finish."""
+    return dict(_FINAL_DIGESTS.get(exp_name, {}))
 from tpfl.learning.dataset import RandomIIDPartitionStrategy, rendered_digits
 from tpfl.management.logger import logger
 from tpfl.models import create_model
@@ -50,6 +62,7 @@ def run_seeded_experiment(
     adversaries: Optional[dict[int, AttackFn]] = None,
     attack_plan: Optional[Any] = None,
     fault_plan: Optional[Any] = None,
+    speed_plan: Optional[Any] = None,
     aggregator_factory: Optional[Callable[[], Any]] = None,
     topology: TopologyType = TopologyType.STAR,
     model_fn: Optional[Callable[[int], Any]] = None,
@@ -122,12 +135,16 @@ def run_seeded_experiment(
         # Declarative chaos: scheduled adversaries + network faults in
         # one spec, wired BEFORE start (learners wrap unstarted nodes).
         plan_truth: dict[str, str] = {}
-        if attack_plan is not None or fault_plan is not None:
+        if (
+            attack_plan is not None
+            or fault_plan is not None
+            or speed_plan is not None
+        ):
             from tpfl.attacks.plan import apply_chaos
 
             plan_truth, _ = apply_chaos(
                 nodes, attack_plan=attack_plan, fault_plan=fault_plan,
-                seed=seed,
+                speed_plan=speed_plan, seed=seed,
             )
         for node in nodes:
             node.start()
@@ -147,6 +164,24 @@ def run_seeded_experiment(
                 )
             _ADVERSARIES[exp_name] = truth
         wait_to_finish(nodes, timeout=timeout)
+        # Byte-determinism receipt: digest every node's final params
+        # BEFORE stop() tears anything down (leaf_bytes: the sanctioned
+        # zero-copy byte view — hashlib consumes the memoryview).
+        import hashlib
+
+        import jax as _jax
+
+        from tpfl.learning.serialization import leaf_bytes
+
+        digests: dict[str, str] = {}
+        for node in nodes:
+            h = hashlib.sha256()
+            for leaf in _jax.tree_util.tree_leaves(
+                node.learner.get_model().get_parameters()
+            ):
+                h.update(leaf_bytes(np.asarray(leaf)))
+            digests[node.addr] = h.hexdigest()
+        _FINAL_DIGESTS[exp_name] = digests
         return exp_name
     finally:
         for node in nodes:

@@ -227,20 +227,67 @@ def apply_attack_plan(nodes: "list[Any]", plan: AttackPlan) -> dict[str, str]:
     return truth
 
 
+class SlowLearner(AdversarialLearner):
+    """Trainer-speed chaos: delegates every fit to the wrapped learner,
+    then sleeps the :class:`tpfl.communication.faults.TrainerSpeedPlan`
+    delay for this address — the fitted PARAMETERS are bit-identical
+    to the undelayed learner's (the sleep follows the compute), only
+    the federation-visible finish time skews. This is how the bench's
+    async tier builds its 10x-skewed fleet reproducibly."""
+
+    def __init__(self, inner: Any, delay: float) -> None:
+        super().__init__(inner, attack=lambda p: p)
+        self._delay = float(delay)
+
+    def fit(self):
+        import time as _time
+
+        model = self._inner.fit()
+        if self._delay > 0:
+            _time.sleep(self._delay)
+        self._last_fit_model = model
+        return model
+
+
+def apply_speed_plan(nodes: "list[Any]", plan: Any) -> None:
+    """Wire a :class:`tpfl.communication.faults.TrainerSpeedPlan` into
+    a federation (nodes must not be started yet): every planned node's
+    learner is wrapped in a :class:`SlowLearner`, and — when the async
+    serialized discipline is active (``Settings.ASYNC_ROUNDS`` +
+    ``ASYNC_SERIALIZED``) — every node's aggregator gets its own fork
+    of the plan-seeded :class:`~tpfl.communication.faults
+    .AsyncSchedule`, so arrival order serializes identically at every
+    node and across same-seed runs."""
+    from tpfl.communication.faults import AsyncSchedule
+
+    for node in nodes:
+        delay = plan.delay_for(node.addr)
+        if delay > 0:
+            node.learner = SlowLearner(node.learner, delay)
+    if Settings.ASYNC_ROUNDS and Settings.ASYNC_SERIALIZED:
+        schedule = AsyncSchedule.for_plan(plan)
+        for node in nodes:
+            node.aggregator.set_async_schedule(schedule.fork())
+
+
 def apply_chaos(
     nodes: "list[Any]",
     attack_plan: Optional[AttackPlan] = None,
     fault_plan: Optional[Any] = None,
+    speed_plan: Optional[Any] = None,
     seed: Optional[int] = None,
 ) -> "tuple[dict[str, str], Any]":
-    """One chaos spec for one federation: malicious peers (attack plan)
-    alongside drops/crashes/partitions (fault plan). Returns
+    """One chaos spec for one federation: malicious peers (attack
+    plan), drops/crashes/partitions (fault plan), and skewed trainer
+    speeds (speed plan) in one wiring call. Returns
     ``(adversary_map, fault_injector)`` — the injector (or None) is
     attached to every node's protocol and its schedule clock started.
     """
     truth: dict[str, str] = {}
     if attack_plan is not None:
         truth = apply_attack_plan(nodes, attack_plan)
+    if speed_plan is not None:
+        apply_speed_plan(nodes, speed_plan)
     injector = None
     if fault_plan is not None:
         from tpfl.communication.faults import FaultInjector

@@ -198,12 +198,15 @@ class ThreadedCommunicationProtocol(CommunicationProtocol):
         serialized_model: "bytes | Any",
         contributors: Optional[list[str]] = None,
         num_samples: int = 0,
+        version: int = -1,
     ) -> Message:
         """``serialized_model``: encoded payload bytes, or — on a
         zero-copy in-process transport — an ``InprocModelRef``. The
         payload's embedded trace id (if telemetry minted one at encode
         time) is mirrored onto the transport envelope so hop spans can
-        tag without re-parsing payload bytes downstream."""
+        tag without re-parsing payload bytes downstream. ``version``:
+        the model-version ordinal an async contribution trained FROM
+        (-1 = untagged; see Message.version)."""
         trace = (
             tracing.payload_trace_id(serialized_model)
             if Settings.TELEMETRY_ENABLED
@@ -217,6 +220,7 @@ class ThreadedCommunicationProtocol(CommunicationProtocol):
             contributors=list(contributors or []),
             num_samples=num_samples,
             trace=trace,
+            version=version,
         )
 
     def model_payload(self, model: Any, delta_base: Optional[tuple] = None) -> Any:
@@ -536,6 +540,7 @@ class ThreadedCommunicationProtocol(CommunicationProtocol):
                         contributors=msg.contributors,
                         num_samples=msg.num_samples,
                         trace=msg.trace,
+                        version=msg.version,
                     )
             else:
                 handler(source=msg.source, round=msg.round, args=msg.args)

@@ -349,13 +349,27 @@ class PartialModelCommand(NodeCommand):
         st = self.state
         if st.round is None:
             return
+        from tpfl.settings import Settings as _S
+
+        if _S.ASYNC_ROUNDS:
+            # Async buffered rounds: contributions are not bound to the
+            # receiver's round — the sender's ROUND NUMBER is just its
+            # own cadence; what matters is the model-version ordinal it
+            # trained from (``version`` on the envelope), which the
+            # aggregator turns into the staleness weight against
+            # WHATEVER round is forming here.
+            self._execute_async(source, weights, contributors,
+                                num_samples, kwargs)
+            return
         if round == st.round + 1:
             # Fast peer already in the next round: hold the model until
             # our TrainStage opens that round (drained there), instead
             # of dropping it and stalling the late trainer for the full
             # aggregation timeout.
             st.stash_pending_partial(
-                (source, round, weights, contributors, num_samples), round
+                (source, round, weights, contributors, num_samples,
+                 int(kwargs.get("version", -1))),
+                round,
             )
             # Close the stash/drain race: if our round advanced (and its
             # aggregation opened) while we were stashing, TrainStage's
@@ -369,6 +383,7 @@ class PartialModelCommand(NodeCommand):
                         weights=args[2],
                         contributors=args[3],
                         num_samples=args[4],
+                        version=args[5],
                     )
             return
         if round != st.round:
@@ -397,6 +412,52 @@ class PartialModelCommand(NodeCommand):
         if covered:
             st.set_models_aggregated(st.addr, covered)
             send_models_aggregated(self.node, covered)
+
+    def _execute_async(
+        self,
+        source: str,
+        weights: bytes,
+        contributors: list[str],
+        num_samples: int,
+        kwargs: dict,
+    ) -> None:
+        """Async-round intake: fold into whatever round is forming.
+        A contribution arriving between rounds (buffer just closed) is
+        stashed and replayed when AsyncRoundStage opens the next one —
+        the serialized-schedule discipline holds it inside the
+        aggregator's reorder buffer instead, which is round-agnostic
+        by construction."""
+        st = self.state
+        trace = kwargs.get("trace", "")
+        raw_version = int(kwargs.get("version", -1))
+        start_version = None if raw_version < 0 else raw_version
+        agg = self.node.aggregator
+        try:
+            with tracing.maybe_span(
+                "decode", st.addr, trace=trace, cmd=self.name, peer=source,
+            ):
+                model = self.node.learner.get_model().build_copy(
+                    params=weights
+                )
+        except Exception as e:
+            logger.error(st.addr, f"PartialModel decode failed: {e}")
+            return
+        with tracing.maybe_span(
+            "fold", st.addr, trace=trace, peer=source,
+        ) as fold_span:
+            covered = agg.add_model(
+                model, trace=trace, start_version=start_version
+            )
+            fold_span.set(covered=len(covered))
+        if not covered and not agg.is_open() and st.round is not None:
+            # Between rounds and no reorder buffer to hold it: stash
+            # for the next round's open (drained by AsyncRoundStage) —
+            # dropping it would waste a real finished fit.
+            st.stash_pending_partial(
+                (source, st.round + 1, weights, contributors, num_samples,
+                 raw_version),
+                st.round + 1,
+            )
 
 
 class CodecNackCommand(NodeCommand):
@@ -495,6 +556,9 @@ class FullModelCommand(NodeCommand):
         with st.relay_lock:
             st.model_version += 1
             st.last_full_model_round = max(st.last_full_model_round, round)
+            # Version-origin bookkeeping (async staleness tags): round
+            # r's aggregate IS model-version ordinal r+1 (init = 0).
+            st.model_round_origin = max(st.model_round_origin, round + 1)
             do_relay = round > st.last_relayed_round
             if do_relay:
                 st.last_relayed_round = round

@@ -316,3 +316,136 @@ class FaultInjector:
         with self._lock:
             for st in self._links.values():
                 st.counters = {}
+
+
+# --- trainer-speed chaos + the async serialization discipline -------------
+
+
+class TrainerSpeedPlan:
+    """Declarative seeded trainer-speed skew: ``addr -> fit delay``
+    (seconds slept around every local fit — the chaos knob that makes
+    heterogeneous fleets reproducible). The bench's async tier builds
+    its 10x-skewed federation from one of these, and the SAME plan
+    seeds the :class:`AsyncSchedule` that serializes async arrival
+    order — so the determinism discipline and the chaos it tames come
+    from a single spec. Pure data: the learner wrapping lives in
+    ``tpfl.attacks.plan`` (layering — this module cannot import the
+    learning layer)."""
+
+    def __init__(
+        self, delays: dict[str, float], seed: Optional[int] = None
+    ) -> None:
+        # unguarded: plan config — built once, read-only after
+        # construction (wrappers and schedules only read).
+        self.delays = dict(delays)
+        self._seed = seed
+
+    @property
+    def seed(self) -> int:
+        """Plan seed (falls back to Settings.SEED at use time — the
+        FaultInjector convention)."""
+        return (Settings.SEED or 0) if self._seed is None else self._seed
+
+    @classmethod
+    def skewed(
+        cls,
+        addrs: Iterable[str],
+        slow_frac: float = 0.2,
+        base_delay: float = 0.05,
+        skew: float = 10.0,
+        seed: Optional[int] = None,
+    ) -> "TrainerSpeedPlan":
+        """A seeded ``skew``-times-slower tail: ``slow_frac`` of the
+        (sorted) addresses — drawn by the plan RNG — sleep
+        ``base_delay * skew`` per fit, the rest ``base_delay``."""
+        plan = cls({}, seed=seed)
+        ordered = sorted(addrs)
+        n_slow = max(1, round(slow_frac * len(ordered))) if ordered else 0
+        slow = set(random.Random(plan.seed).sample(ordered, n_slow))
+        plan.delays = {
+            a: base_delay * (skew if a in slow else 1.0) for a in ordered
+        }
+        return plan
+
+    def delay_for(self, addr: str) -> float:
+        return float(self.delays.get(addr, 0.0))
+
+
+class AsyncSchedule:
+    """Seeded total order over async contributions — the serialized
+    arrival discipline (``Settings.ASYNC_SERIALIZED``).
+
+    Built from per-trainer periods (a :class:`TrainerSpeedPlan`'s
+    delays), the schedule assigns contribution ``c`` of trainer ``t``
+    the virtual finish time ``(c+1) * period(t)`` and orders all
+    contributions by ``(virtual time, seeded trainer rank)``. An
+    aggregator holding out-of-order arrivals in a reorder buffer and
+    folding strictly in this order folds an identical sequence at
+    every node and in every same-seed run — the property the bench's
+    async byte-determinism boolean asserts. Because the periods mirror
+    the real (injected) trainer speeds, actual arrival order tracks
+    schedule order and the reorder buffer almost never waits.
+
+    Stateful consumer-side: each aggregator takes its OWN instance
+    (:meth:`fork`) — same ``(periods, seed)`` ⇒ same order everywhere.
+    """
+
+    def __init__(
+        self, periods: dict[str, float], seed: Optional[int] = None
+    ) -> None:
+        # unguarded: all mutable state is owned by one Aggregator and
+        # accessed under its _lock (the schedule is handed over whole).
+        self._seed = seed
+        self.periods = {
+            a: max(float(p), 1e-3) for a, p in dict(periods).items()
+        }
+        ordered = sorted(self.periods)
+        # Seeded rank breaks virtual-time ties between equal-period
+        # trainers without depending on address sort order alone.
+        rng = random.Random(
+            ((Settings.SEED or 0) if seed is None else seed) ^ 0x5EED
+        )
+        shuffled = list(ordered)
+        rng.shuffle(shuffled)
+        self._rank = {a: i for i, a in enumerate(shuffled)}
+        import heapq
+
+        self._heapq = heapq
+        self._heap: list[tuple[float, int, str]] = [
+            (self.periods[a], self._rank[a], a) for a in ordered
+        ]
+        heapq.heapify(self._heap)
+
+    @classmethod
+    def for_plan(cls, plan: TrainerSpeedPlan) -> "AsyncSchedule":
+        return cls(plan.delays, seed=plan.seed)
+
+    def fork(self) -> "AsyncSchedule":
+        """A fresh same-order instance (one per aggregator)."""
+        return AsyncSchedule(self.periods, seed=self._seed)
+
+    def knows(self, addr: str) -> bool:
+        return addr in self.periods
+
+    def expected(self) -> Optional[str]:
+        """The trainer whose contribution is next in schedule order
+        (None for an empty schedule)."""
+        return self._heap[0][2] if self._heap else None
+
+    def advance(self) -> None:
+        """Consume the head (its contribution was admitted) and
+        schedule that trainer's next contribution."""
+        if not self._heap:
+            return
+        vt, rank, addr = self._heapq.heappop(self._heap)
+        self._heapq.heappush(
+            self._heap, (vt + self.periods[addr], rank, addr)
+        )
+
+    def skip(self) -> Optional[str]:
+        """Liveness escape: advance past the head WITHOUT a
+        contribution (deadline close on a dead trainer). Breaks the
+        byte-determinism guarantee for this run — the caller logs it."""
+        head = self.expected()
+        self.advance()
+        return head

@@ -49,6 +49,11 @@ class Message:
     # telemetry is off or the message carries no traced payload;
     # pre-telemetry peers ignore the extra wire key.
     trace: str = ""
+    # Model-version ordinal a weights contribution was trained FROM
+    # (async buffered rounds, Settings.ASYNC_ROUNDS): the receiver's
+    # staleness weight is keyed off it. -1 = untagged (sync payloads,
+    # pre-async peers — decoded as staleness 0 at intake).
+    version: int = -1
 
     @property
     def is_weights(self) -> bool:
@@ -87,6 +92,7 @@ class Message:
                 "n": self.num_samples,
                 "v": self.via,
                 "t": self.trace,
+                "mv": self.version,
             },
             use_bin_type=True,
         )
@@ -106,4 +112,5 @@ class Message:
             num_samples=d["n"],
             via=d.get("v", ""),
             trace=d.get("t", ""),
+            version=d.get("mv", -1),
         )

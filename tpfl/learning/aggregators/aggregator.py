@@ -36,6 +36,21 @@ class NoModelsToAggregateError(Exception):
     """wait_and_get_aggregation timed out with zero models."""
 
 
+def staleness_weight(tau: int) -> float:
+    """FedBuff-style staleness decay ``w(τ) = 1/(1+τ)**exp``
+    (``Settings.ASYNC_STALENESS_EXP``): a contribution trained from a
+    model ``τ`` version ordinals behind the round it folds into is
+    down-weighted polynomially — τ=0 (fresh) folds at full weight, and
+    exp=0 disables discounting entirely. Used by the async buffered
+    rounds (``set_nodes_to_aggregate(async_k=...)``); synchronous
+    rounds never call it (every sync contribution is τ=0 by
+    construction)."""
+    exp = float(Settings.ASYNC_STALENESS_EXP)
+    if exp == 0.0 or tau <= 0:
+        return 1.0
+    return float((1.0 + float(tau)) ** -exp)
+
+
 def stack_models(models: list[TpflModel]) -> tuple[Any, jnp.ndarray]:
     """Stack N parameter pytrees along a leading node axis and return the
     per-model sample counts — one fused XLA op per leaf instead of a
@@ -122,6 +137,40 @@ class Aggregator(ABC):
         # coverage spreading — is unchanged; only the math skips them).
         # guarded-by: _lock
         self._excluded: dict[int, str] = {}
+        # --- asynchronous buffered rounds (Settings.ASYNC_ROUNDS) ---
+        # Buffer size that closes the open round (0 = synchronous
+        # round: close on coverage/quorum, the reference lifecycle).
+        # Writes serialize under _lock; lock-free int reads (mode
+        # checks) see at worst one round of drift.
+        # guarded-by: _lock writes
+        self._async_k: int = 0
+        # Model-version ordinal of the round being formed — the "r" in
+        # a contribution's staleness τ = r - start_version. Same
+        # read/write discipline as _async_k.
+        # guarded-by: _lock writes
+        self._round_ordinal: int = 0
+        # Per-held-model staleness ordinals, keyed by object identity
+        # (like _excluded) — read by the close-time weighted fold.
+        # guarded-by: _lock
+        self._staleness: dict[int, int] = {}
+        # Why the open round closed: "coverage" (sync), "buffer_full",
+        # or "deadline"; None while open. Lock-free reads (a string
+        # ref read is GIL-atomic; consumers query after close).
+        # guarded-by: _lock writes
+        self._close_reason: "str | None" = None
+        # Serialized-arrival discipline (Settings.ASYNC_SERIALIZED +
+        # an attached seeded AsyncSchedule): out-of-schedule-order
+        # arrivals wait here, keyed by contributor, and admit strictly
+        # in schedule order — the reorder buffer that makes same-seed
+        # async runs fold identical sequences. Survives round
+        # boundaries (a held contribution admits in a later round at
+        # higher staleness); reset when a new schedule attaches. The
+        # schedule reference is written once per experiment (before
+        # nodes start); its internal state mutates only under _lock.
+        # guarded-by: _lock writes
+        self._async_sched: Any = None
+        # guarded-by: _lock
+        self._async_hold: dict[str, list] = {}
         self._lock = make_lock("Aggregator._lock")
         self._finish_aggregation_event = threading.Event()
         self._finish_aggregation_event.set()
@@ -211,13 +260,28 @@ class Aggregator(ABC):
 
     # --- round lifecycle ---
 
-    def set_nodes_to_aggregate(self, nodes: list[str]) -> None:
+    def set_nodes_to_aggregate(
+        self,
+        nodes: list[str],
+        async_k: "int | None" = None,
+        round_ordinal: int = 0,
+    ) -> None:
         """Start a round: declare the train set whose contributions we
-        await (reference aggregator.py:76-91)."""
+        await (reference aggregator.py:76-91).
+
+        ``async_k`` opens an ASYNCHRONOUS buffered round instead
+        (Settings.ASYNC_ROUNDS lifecycle): close fires on ``async_k``
+        distinct covered contributors — whoever finishes first — not
+        on covering the declared set, so no slowest-trainer barrier
+        exists. ``round_ordinal`` is the model-version ordinal this
+        round will produce; contributions tagged with the version they
+        trained FROM fold at ``staleness_weight(ordinal - version)``
+        times their sample weight."""
         if not self._finish_aggregation_event.is_set():
             raise Exception(
                 f"({self.node_name}) Aggregation already in progress"
             )
+        drained: list = []
         with self._lock:
             self._train_set = list(nodes)
             self._models = []
@@ -225,17 +289,57 @@ class Aggregator(ABC):
             self._stream_dead = False
             self._removed_dead = set()
             self._excluded = {}
+            self._staleness = {}
+            self._close_reason = None
+            self._async_k = (
+                max(1, min(int(async_k), len(nodes))) if async_k else 0
+            )
+            self._round_ordinal = int(round_ordinal)
             self.version += 1
             self._last_intake = time.monotonic()
             # Clear under the lock: a model arriving between the train-set
             # assignment and the clear would otherwise see the event still
             # set in add_model and be dropped at round start.
             self._finish_aggregation_event.clear()
+            # Contributions held by the serialized-arrival reorder
+            # buffer while no round was open admit into this one.
+            if self._async_k and self._async_sched is not None:
+                drained = self._drain_schedule_locked()
+        self._post_admit(drained)
+
+    def set_async_schedule(self, schedule: Any) -> None:
+        """Attach a seeded :class:`tpfl.communication.faults
+        .AsyncSchedule` (this aggregator's OWN instance — callers
+        ``fork()`` per node): async intake then holds out-of-order
+        arrivals and admits strictly in schedule order, which is what
+        makes same-seed serialized runs byte-identical. ``None``
+        detaches. Resets the reorder buffer either way (a schedule
+        belongs to one experiment)."""
+        with self._lock:
+            self._async_sched = schedule
+            self._async_hold = {}
+
+    def is_async(self) -> bool:
+        """True while the open (or last-opened) round is buffered
+        async."""
+        return bool(self._async_k)
+
+    def close_reason(self) -> "str | None":
+        """Why the current round's aggregation closed ("coverage",
+        "buffer_full", "deadline"); None while still open."""
+        return self._close_reason
 
     def is_open(self) -> bool:
         """True while a round's aggregation is in progress (between
         set_nodes_to_aggregate and full coverage / clear)."""
         return not self._finish_aggregation_event.is_set()
+
+    def wait_closed(self, timeout: "float | None" = None) -> bool:
+        """Block until the open round's aggregation closes (coverage,
+        buffer-full, deadline, or clear); True when closed. The async
+        stage's round wait — event-driven, so a buffer-full close wakes
+        it immediately instead of on the next poll tick."""
+        return self._finish_aggregation_event.wait(timeout=timeout)
 
     def stalled(self, stall_seconds: float) -> bool:
         """True when intake has gone quiet: the round is still open,
@@ -287,6 +391,12 @@ class Aggregator(ABC):
         with self._lock:
             if self._finish_aggregation_event.is_set():
                 return True
+            if self._async_k:
+                # Async rounds never await specific members — a dead
+                # trainer simply stops contributing, and the buffer
+                # closes on whoever is alive (or the deadline). Nothing
+                # to shrink.
+                return False
             covered = {c for m in self._models for c in m.get_contributors()}
             removable = [
                 a for a in addrs if a in self._train_set and a not in covered
@@ -322,6 +432,55 @@ class Aggregator(ABC):
             flight.dump(self.node_name, "quorum_degraded")
         return closed
 
+    def async_deadline_close(self) -> bool:
+        """Deadline failsafe for an async buffered round
+        (``Settings.ASYNC_ROUND_DEADLINE``, polled by
+        ``AsyncRoundStage``): close the round with whatever the buffer
+        holds. Returns True when the round is (now) closed.
+
+        An EMPTY buffer fails open LOUDLY — there is nothing to
+        aggregate, so closing would only brick the round: the deadline
+        event/counter still fire (the observability a silent stall
+        denies), the round stays open, and the caller re-arms. The
+        quorum-degradation economics apply either way: a dead trainer
+        costs at most one deadline, never AGGREGATION_TIMEOUT."""
+        with self._lock:
+            if self._finish_aggregation_event.is_set():
+                return True
+            if not self._async_k:
+                return False
+            held = bool(self._models)
+            if held:
+                self._close_reason = "deadline"
+                self._finish_aggregation_event.set()
+        # Telemetry OUTSIDE _lock (protocol critical sections never
+        # extend for observability) — the satellite surface: a
+        # round_deadline flight event traceview places on the round
+        # timeline, plus the counter dashboards alert on.
+        logger.metrics.counter(
+            "tpfl_agg_deadline_total",
+            labels={
+                "node": self.node_name,
+                "outcome": "closed" if held else "empty",
+            },
+        )
+        tracing.event(
+            "round_deadline", self.node_name,
+            outcome="closed" if held else "empty",
+            round=self._round_ordinal,
+        )
+        if not held:
+            logger.warning(
+                self.node_name,
+                f"Async round {self._round_ordinal} deadline expired with "
+                "an EMPTY buffer; failing open (round stays open, "
+                "deadline re-arms) — no contribution, not even our own "
+                "fit, has arrived",
+            )
+            return False
+        self._emit_async_close("deadline")
+        return True
+
     def clear(self) -> None:
         """End a round (reference RoundFinishedStage calls this)."""
         with self._lock:
@@ -331,6 +490,8 @@ class Aggregator(ABC):
             self._stream_dead = False
             self._removed_dead = set()
             self._excluded = {}
+            self._staleness = {}
+            self._close_reason = None
             self.version += 1
         self._finish_aggregation_event.set()
         # Drop the ledger's round reference/accumulator (unconditional:
@@ -350,7 +511,22 @@ class Aggregator(ABC):
             covered = {c for m in self._models for c in m.get_contributors()}
             return set(self._train_set) - covered
 
-    def add_model(self, model: TpflModel, trace: str = "") -> list[str]:
+    def _staleness_of(self, start_version: "int | None") -> int:
+        """Staleness ordinal of a contribution trained from model
+        version ``start_version`` folding into the round being formed
+        (0 for untagged contributions and for synchronous rounds).
+        Lock-free reads of the write-guarded ordinals (stale read =
+        one ordinal of drift on a value that only ever grows)."""
+        if start_version is None or not self._async_k:
+            return 0
+        return max(0, int(self._round_ordinal) - int(start_version))
+
+    def add_model(
+        self,
+        model: TpflModel,
+        trace: str = "",
+        start_version: "int | None" = None,
+    ) -> list[str]:
         """Add a (possibly partially-aggregated) model; returns the list
         of contributors now covered, or [] if the model was rejected
         (reference aggregator.py:113-175).
@@ -358,12 +534,19 @@ class Aggregator(ABC):
         ``trace``: the PR-5 trace id of the payload that carried this
         contribution (PartialModelCommand threads it through) — the
         ledger's join key between a contribution's statistics and its
-        hop timeline. "" for locally-fitted models."""
+        hop timeline. "" for locally-fitted models.
+
+        ``start_version``: async rounds only — the model-version
+        ordinal the contributor trained FROM; the fold weight decays
+        by :func:`staleness_weight` of its distance from the forming
+        round's ordinal, and the ledger/quarantine taps carry the same
+        staleness so detection windows stay per-version."""
         try:
             contributors = model.get_contributors()
         except ValueError:
             logger.debug(self.node_name, "Dropping model with no contributors")
             return []
+        staleness = self._staleness_of(start_version)
         # Active-defense verdict BEFORE the fold (outside _lock — the
         # live scoring dispatches a jitted reduction; the engine/ledger
         # hold only their own leaf locks). An excluded contribution is
@@ -375,7 +558,9 @@ class Aggregator(ABC):
         # computed once per (peer, round).
         verdict: "dict | None" = None
         if Settings.QUARANTINE_ENABLED and self._quarantine is not None:
-            verdict = self._quarantine.assess(model, contributors, trace=trace)
+            verdict = self._quarantine.assess(
+                model, contributors, trace=trace, staleness=staleness
+            )
         if verdict is not None and verdict["exclude"] and not verdict["recorded"]:
             # All-quarantined mixture: pure poison, nothing coverage
             # needs from it (each member's own contribution covers it).
@@ -384,10 +569,34 @@ class Aggregator(ABC):
                 f"Dropping quarantined mixture from {contributors}",
             )
             return []
+        exclude = bool(verdict is not None and verdict["exclude"])
+        recorded = bool(verdict is not None and verdict["recorded"])
+        # Serialized async discipline: single contributions from
+        # scheduled trainers enter the reorder buffer and admit
+        # strictly in schedule order (possibly later, possibly
+        # unblocking other held arrivals).
+        if (
+            self._async_k
+            and self._async_sched is not None
+            and len(contributors) == 1
+            and self._async_sched.knows(contributors[0])
+        ):
+            with self._lock:
+                self._async_hold.setdefault(contributors[0], []).append(
+                    (model, start_version, exclude, trace, recorded)
+                )
+                drained = (
+                    self._drain_schedule_locked()
+                    if not self._finish_aggregation_event.is_set()
+                    else []
+                )
+                covered = {
+                    c for m in self._models for c in m.get_contributors()
+                }
+            self._post_admit(drained)
+            return sorted(covered)
         covered_out: "list[str] | None" = self._intake(
-            model,
-            contributors,
-            exclude=bool(verdict is not None and verdict["exclude"]),
+            model, contributors, exclude=exclude, start_version=start_version
         )
         if covered_out is None:
             return []
@@ -397,140 +606,263 @@ class Aggregator(ABC):
         # proceeds; one attribute read when LEDGER_ENABLED is off. The
         # quarantine assessment above already recorded+scored single
         # contributions eagerly — don't double-record those.
-        if Settings.LEDGER_ENABLED and not (
-            verdict is not None and verdict["recorded"]
-        ):
-            ledger.contrib.record(self.node_name, model, trace=trace)
+        if Settings.LEDGER_ENABLED and not recorded:
+            ledger.contrib.record(
+                self.node_name, model, trace=trace, staleness=staleness
+            )
         return covered_out
 
+    def _drain_schedule_locked(self) -> list:
+        """Caller holds ``_lock``. Admit reorder-buffered contributions
+        in schedule order while the round stays open and the head of
+        the schedule is present; returns the admitted entries for the
+        post-lock telemetry/ledger taps (:meth:`_post_admit`)."""
+        admitted: list = []
+        sched = self._async_sched
+        while not self._finish_aggregation_event.is_set():
+            exp = sched.expected()
+            if exp is None:
+                break
+            queue = self._async_hold.get(exp)
+            if not queue:
+                break
+            model, start_version, exclude, trace, recorded = queue.pop(0)
+            # The schedule slot is consumed whether or not the round's
+            # coverage checks accept the model — every node sees the
+            # same sequence, so the rejection is identical everywhere.
+            sched.advance()
+            covered = self._admit_locked(
+                model, [exp], exclude=exclude, start_version=start_version
+            )
+            admitted.append(
+                (
+                    model, trace, recorded, covered,
+                    self._staleness.get(id(model), 0),
+                )
+            )
+        return admitted
+
+    def _post_admit(self, admitted: list) -> None:
+        """Ledger taps + close telemetry for schedule-drained
+        admissions, OUTSIDE ``_lock`` (telemetry never extends a
+        protocol critical section)."""
+        closed_now = False
+        for model, trace, recorded, covered, tau in admitted:
+            if covered is None:
+                continue
+            closed_now = closed_now or not self.is_open()
+            if Settings.LEDGER_ENABLED and not recorded:
+                ledger.contrib.record(
+                    self.node_name, model, trace=trace, staleness=tau
+                )
+        if closed_now:
+            self._emit_async_close("buffer_full")
+
+    def _emit_async_close(self, reason: str) -> None:
+        """Close-reason observability for async rounds: a counter for
+        dashboards and a flight-ring event traceview can place on the
+        round timeline."""
+        logger.metrics.counter(
+            "tpfl_agg_async_close_total",
+            labels={"node": self.node_name, "reason": reason},
+        )
+        tracing.event(
+            "round_close", self.node_name,
+            reason=reason, round=self._round_ordinal,
+        )
+
     def _intake(
-        self, model: TpflModel, contributors: list[str], exclude: bool = False
+        self,
+        model: TpflModel,
+        contributors: list[str],
+        exclude: bool = False,
+        start_version: "int | None" = None,
     ) -> "list[str] | None":
         """The locked intake half of :meth:`add_model`: returns the
         covered list on acceptance, None on rejection. ``exclude``
         (quarantine verdict) accepts the model for coverage bookkeeping
         but keeps its params out of every fold."""
         with self._lock:
-            if self._finish_aggregation_event.is_set():
-                logger.debug(
-                    self.node_name, "Dropping model: no aggregation in progress"
+            was_open = not self._finish_aggregation_event.is_set()
+            out = self._admit_locked(
+                model, contributors, exclude=exclude,
+                start_version=start_version,
+            )
+            closed_now = (
+                was_open
+                and out is not None
+                and self._finish_aggregation_event.is_set()
+            )
+        if closed_now and self._async_k:
+            self._emit_async_close("buffer_full")
+        return out
+
+    def _admit_locked(
+        self,
+        model: TpflModel,
+        contributors: list[str],
+        exclude: bool = False,
+        start_version: "int | None" = None,
+    ) -> "list[str] | None":
+        """Caller holds ``_lock``: the coverage checks + fold
+        bookkeeping of one contribution."""
+        if self._finish_aggregation_event.is_set():
+            logger.debug(
+                self.node_name, "Dropping model: no aggregation in progress"
+            )
+            return None
+        if not self._train_set:
+            logger.debug(self.node_name, "Dropping model: no train set")
+            return None
+        extras = set(contributors) - set(self._train_set)
+        if extras:
+            if self._async_k:
+                # Async rounds have no elected set to police: the
+                # "train set" is the live-peer snapshot at round open,
+                # and a peer that joined since simply grows it (its
+                # contribution is as foldable as anyone's).
+                self._train_set = list(self._train_set) + sorted(extras)
+            elif extras <= self._removed_dead:
+                # A peer that shrank later (or not at all) bundles a
+                # member we declared dead. Its contribution is
+                # real — rejecting it would deadlock the exchange
+                # (that peer re-pushes the same partial until its
+                # static-exit) and burn AGGREGATION_TIMEOUT here.
+                # Re-admit: the member arrives covered by this very
+                # model, so nothing new is awaited, and peers that
+                # shrank at different times converge on the SAME
+                # contributor set instead of diverging.
+                self._train_set = list(self._train_set) + sorted(extras)
+                self._removed_dead -= extras
+                logger.warning(
+                    self.node_name,
+                    f"Re-admitting dead-dropped members {sorted(extras)}: "
+                    f"their contribution arrived via {contributors}",
                 )
-                return None
-            if not self._train_set:
-                logger.debug(self.node_name, "Dropping model: no train set")
-                return None
-            extras = set(contributors) - set(self._train_set)
-            if extras:
-                if extras <= self._removed_dead:
-                    # A peer that shrank later (or not at all) bundles a
-                    # member we declared dead. Its contribution is
-                    # real — rejecting it would deadlock the exchange
-                    # (that peer re-pushes the same partial until its
-                    # static-exit) and burn AGGREGATION_TIMEOUT here.
-                    # Re-admit: the member arrives covered by this very
-                    # model, so nothing new is awaited, and peers that
-                    # shrank at different times converge on the SAME
-                    # contributor set instead of diverging.
-                    self._train_set = list(self._train_set) + sorted(extras)
-                    self._removed_dead -= extras
-                    logger.warning(
-                        self.node_name,
-                        f"Re-admitting dead-dropped members {sorted(extras)}: "
-                        f"their contribution arrived via {contributors}",
-                    )
-                else:
-                    logger.debug(
-                        self.node_name,
-                        f"Dropping model: contributors {contributors} not in train set",
-                    )
-                    return None
-            covered = {c for m in self._models for c in m.get_contributors()}
-            if set(contributors).issubset(covered):
+            else:
                 logger.debug(
                     self.node_name,
-                    f"Dropping model: contributors {contributors} already covered",
+                    f"Dropping model: contributors {contributors} not in train set",
                 )
                 return None
-            if covered & set(contributors):
-                # Overlap would double-count in a weighted mean.
-                logger.debug(
-                    self.node_name,
-                    f"Dropping model: contributors {contributors} overlap {covered}",
-                )
-                return None
-            self._models.append(model)
-            if exclude:
-                # Quarantined: coverage-only passenger. Params never
-                # fold; the eager stream counts it "offered" (like a
-                # skipped zero-sample fit) so the close-time
-                # offered-vs-held consistency check still trusts the
-                # stream.
-                self._excluded[id(model)] = ",".join(sorted(contributors))
-                if (
-                    self.SUPPORTS_STREAMING
-                    and Settings.AGG_STREAM_EAGER
-                    and not self._stream_dead
-                ):
-                    try:
-                        if self._stream is None:
-                            self._stream = self.acc_init(model)
-                        self._stream.offered += 1
-                    except Exception:
-                        self._stream = None
-                        self._stream_dead = True
-            # Eager on-arrival reduce (Settings.AGG_STREAM_EAGER): fold
-            # the accepted contribution into the on-device accumulator
-            # NOW, so the round-close aggregation is one finalize
-            # instead of an O(N)-fold on the critical tail. The jitted
-            # update dispatches asynchronously — the lock is held only
-            # for the enqueue, not the device work. Any fold error
-            # kills the stream for the round; close falls back to the
-            # batch fold over the held models (which reports the error
-            # through the normal aggregate() path).
+        covered = {c for m in self._models for c in m.get_contributors()}
+        if set(contributors).issubset(covered):
+            logger.debug(
+                self.node_name,
+                f"Dropping model: contributors {contributors} already covered",
+            )
+            return None
+        if covered & set(contributors):
+            # Overlap would double-count in a weighted mean.
+            logger.debug(
+                self.node_name,
+                f"Dropping model: contributors {contributors} overlap {covered}",
+            )
+            return None
+        self._models.append(model)
+        tau = 0
+        if self._async_k:
+            if start_version is not None:
+                tau = max(0, self._round_ordinal - int(start_version))
+            self._staleness[id(model)] = tau
+        # Eager folds: sync rounds follow Settings.AGG_STREAM_EAGER;
+        # async rounds fold eagerly only when FREE-RUNNING
+        # (ASYNC_SERIALIZED off) — the serialized discipline defers
+        # every fold to the round close so the reduction order is
+        # deterministic regardless of arrival interleaving.
+        eager = (
+            Settings.AGG_STREAM_EAGER
+            if not self._async_k
+            else not Settings.ASYNC_SERIALIZED
+        )
+        if exclude:
+            # Quarantined: coverage-only passenger. Params never
+            # fold; the eager stream counts it "offered" (like a
+            # skipped zero-sample fit) so the close-time
+            # offered-vs-held consistency check still trusts the
+            # stream.
+            self._excluded[id(model)] = ",".join(sorted(contributors))
             if (
-                not exclude
-                and self.SUPPORTS_STREAMING
-                and Settings.AGG_STREAM_EAGER
+                self.SUPPORTS_STREAMING
+                and eager
                 and not self._stream_dead
             ):
                 try:
-                    t_fold = time.monotonic()
                     if self._stream is None:
                         self._stream = self.acc_init(model)
-                    self._stream = self.accumulate(self._stream, model)
-                    logger.metrics.observe(
-                        "tpfl_agg_fold_seconds",
-                        time.monotonic() - t_fold,
-                        labels={"node": self.node_name},
-                    )
-                    # Round attribution: eager folds are "fold" time
-                    # even when they run on a handler thread while the
-                    # learning thread sits in the gossip wait.
-                    profiling.rounds.add(
-                        self.node_name, "fold", time.monotonic() - t_fold
-                    )
-                except Exception as e:
-                    logger.debug(
-                        self.node_name,
-                        f"Eager accumulate failed ({e}); will batch-fold "
-                        "at round close",
-                    )
+                    self._stream.offered += 1
+                except Exception:
                     self._stream = None
                     self._stream_dead = True
-            self.version += 1
-            self._last_intake = time.monotonic()
-            covered |= set(contributors)
-            logger.debug(
-                self.node_name,
-                f"Model added ({len(covered)}/{len(self._train_set)}) from {contributors}",
-            )
-            # Quorum close (Settings.ROUND_QUORUM): at the default 1.0
-            # this fires exactly on full coverage (reference behavior);
-            # below 1.0 it closes once the configured fraction of the
-            # (possibly dead-shrunk) expected set has reported.
-            if self._covered_meets_quorum(covered):
+        # Eager on-arrival reduce (Settings.AGG_STREAM_EAGER): fold
+        # the accepted contribution into the on-device accumulator
+        # NOW, so the round-close aggregation is one finalize
+        # instead of an O(N)-fold on the critical tail. The jitted
+        # update dispatches asynchronously — the lock is held only
+        # for the enqueue, not the device work. Any fold error
+        # kills the stream for the round; close falls back to the
+        # batch fold over the held models (which reports the error
+        # through the normal aggregate() path).
+        if (
+            not exclude
+            and self.SUPPORTS_STREAMING
+            and eager
+            and not self._stream_dead
+        ):
+            try:
+                t_fold = time.monotonic()
+                if self._stream is None:
+                    self._stream = self.acc_init(model)
+                if self._async_k:
+                    # Staleness-discounted fold weight (FedBuff):
+                    # sample mass decayed by the version distance.
+                    self._stream = self.accumulate(
+                        self._stream, model,
+                        weight=model.get_num_samples()
+                        * staleness_weight(tau),
+                    )
+                else:
+                    self._stream = self.accumulate(self._stream, model)
+                logger.metrics.observe(
+                    "tpfl_agg_fold_seconds",
+                    time.monotonic() - t_fold,
+                    labels={"node": self.node_name},
+                )
+                # Round attribution: eager folds are "fold" time
+                # even when they run on a handler thread while the
+                # learning thread sits in the gossip wait.
+                profiling.rounds.add(
+                    self.node_name, "fold", time.monotonic() - t_fold
+                )
+            except Exception as e:
+                logger.debug(
+                    self.node_name,
+                    f"Eager accumulate failed ({e}); will batch-fold "
+                    "at round close",
+                )
+                self._stream = None
+                self._stream_dead = True
+        self.version += 1
+        self._last_intake = time.monotonic()
+        covered |= set(contributors)
+        logger.debug(
+            self.node_name,
+            f"Model added ({len(covered)}/{len(self._train_set)}) from {contributors}",
+        )
+        if self._async_k:
+            # Buffer-full close (FedBuff's K): whoever reported first —
+            # the round never waits for anyone in particular.
+            if len(covered) >= self._async_k:
+                self._close_reason = "buffer_full"
                 self._finish_aggregation_event.set()
-            return sorted(covered)
+        # Quorum close (Settings.ROUND_QUORUM): at the default 1.0
+        # this fires exactly on full coverage (reference behavior);
+        # below 1.0 it closes once the configured fraction of the
+        # (possibly dead-shrunk) expected set has reported.
+        elif self._covered_meets_quorum(covered):
+            self._close_reason = "coverage"
+            self._finish_aggregation_event.set()
+        return sorted(covered)
 
     # --- results ---
 
@@ -552,6 +884,8 @@ class Aggregator(ABC):
             )
             stream, self._stream = self._stream, None
             excluded_ids = dict(self._excluded)
+            async_k = self._async_k
+            staleness = dict(self._staleness)
             # Snapshot for the timeout log below: _train_set is
             # _lock-guarded state and remove_dead_nodes/add_model keep
             # mutating it after this block releases the lock.
@@ -604,6 +938,20 @@ class Aggregator(ABC):
                     # the round's reduce already happened on-device as
                     # partials arrived — close is a single finalize.
                     out = self.finalize(stream)
+                elif async_k and self.SUPPORTS_STREAMING:
+                    # Serialized async close: the deferred
+                    # staleness-weighted fold, in the canonical
+                    # contributor-sorted order (``models`` above) — a
+                    # deterministic reduction over a deterministic set
+                    # is what the byte-determinism receipt rests on.
+                    state = self.acc_init(fold_models[0])
+                    for m in fold_models:
+                        state = self.accumulate(
+                            state, m,
+                            weight=m.get_num_samples()
+                            * staleness_weight(staleness.get(id(m), 0)),
+                        )
+                    out = self.finalize(state)
                 else:
                     out = self.aggregate(fold_models)
                 return self._with_passengers(

@@ -352,10 +352,16 @@ class ContributionLedger:
             self._open.pop(node, None)
 
     def record(
-        self, node: str, model: Any, trace: str = ""
+        self, node: str, model: Any, trace: str = "", staleness: int = 0
     ) -> "dict | None":
         """Record one accepted contribution; returns the ledger entry
         (or None when disabled / no round is open on ``node``).
+
+        ``staleness``: async buffered rounds' version-distance ordinal
+        (0 for sync rounds). Rides the entry as ``staleness`` plus the
+        derived ``version`` (= fold round − staleness, the model
+        version the update was trained FROM) so detection windows and
+        traceview joins stay keyed per-version, not per-wall-clock.
 
         Single-contributor models get the full fused on-device stat
         reduction + anomaly scoring. Multi-contributor PARTIAL
@@ -390,6 +396,8 @@ class ContributionLedger:
                 "contributors": contributors,
                 "single": True,
                 "round": st["round"],
+                "staleness": int(staleness),
+                "version": st["round"] - int(staleness),
                 "num_samples": int(model.get_num_samples()),
                 "update_norm": None,
                 "ref_norm": None,
@@ -413,7 +421,7 @@ class ContributionLedger:
         return entry
 
     def score_now(
-        self, node: str, model: Any, trace: str = ""
+        self, node: str, model: Any, trace: str = "", staleness: int = 0
     ) -> "dict | None":
         """Eagerly record AND score one single-contributor contribution
         at intake — the active-defense path (tpfl.management.quarantine
@@ -470,12 +478,19 @@ class ContributionLedger:
                 # relies on). Zero added device work.
                 scored = dict(cached)
             else:
+                # Per-VERSION window (async staleness discipline): the
+                # norm baseline is prior clean entries from EARLIER
+                # model versions than the one this update trained from.
+                # Sync rounds have staleness 0 everywhere, so version
+                # == round and this is bit-identical to the historical
+                # prior-rounds filter.
+                version = st["round"] - int(staleness)
                 window = [
                     x["update_norm"]
                     for x in ring
                     if x["single"]
                     and x["update_norm"] is not None
-                    and x["round"] < st["round"]
+                    and x.get("version", x["round"]) < version
                     and not x["flagged"]
                 ]
                 scalars_dev, leaf_dev, new_acc = _stats(
@@ -512,6 +527,8 @@ class ContributionLedger:
                 "contributors": contributors,
                 "single": True,
                 "round": st["round"],
+                "staleness": int(staleness),
+                "version": st["round"] - int(staleness),
                 "num_samples": int(model.get_num_samples()),
                 "trace": trace,
                 "t": time.monotonic(),

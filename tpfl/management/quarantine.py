@@ -108,18 +108,28 @@ class QuarantineEngine:
     # --- the decision point (Aggregator.add_model) ---
 
     def assess(
-        self, model: Any, contributors: list[str], trace: str = ""
+        self,
+        model: Any,
+        contributors: list[str],
+        trace: str = "",
+        staleness: int = 0,
     ) -> "dict | None":
         """Verdict for one intake: ``{"exclude", "recorded", "reasons"}``
         or None when the defense is off. ``recorded`` tells the
         aggregator the ledger entry already exists (so the passive
-        record tap must not double-record)."""
+        record tap must not double-record). ``staleness``: async
+        rounds' version-distance ordinal — threaded into the ledger
+        entry so the scorer's norm window stays keyed to MODEL VERSION,
+        not wall-clock arrival (a stale honest update's norm belongs
+        with its own version's population, not the current round's)."""
         if not Settings.QUARANTINE_ENABLED:
             return None
         if len(contributors) != 1:
             return self._assess_partial(contributors)
         peer = contributors[0]
-        entry = ledger.contrib.score_now(self.node, model, trace=trace)
+        entry = ledger.contrib.score_now(
+            self.node, model, trace=trace, staleness=staleness
+        )
         if entry is None:
             # No open round on this node (round not started / defense
             # raced a round boundary): nothing to judge against.

@@ -150,6 +150,12 @@ class Node:
         self.completed_experiment: Optional[str] = None
         self.learning_workflow = LearningWorkflow()
         self._learning_thread: Optional[threading.Thread] = None
+        # Free-running async trainer loop (stages.AsyncRoundStage
+        # ._ensure_trainer_loop): one daemon thread per experiment,
+        # exits via check_early_stop / experiment-name change.
+        # unguarded: written only by the learning thread (stage
+        # entry); the thread object itself is the synchronization.
+        self._async_trainer_thread: Optional[threading.Thread] = None
         self._running = False
         self.rng = random.Random((Settings.SEED or 0) + zlib.crc32(self.addr.encode()))
 
@@ -176,6 +182,14 @@ class Node:
             return
         if self.state.status == "Learning":
             self.stop_learning()
+        # Async trainer loop (free-running ASYNC_ROUNDS): make sure its
+        # in-flight fit is interrupted and the thread drains before the
+        # process can exit — a daemon thread parked inside an XLA
+        # dispatch at interpreter teardown aborts the process.
+        trainer = self._async_trainer_thread
+        if trainer is not None and trainer.is_alive():
+            self.learner.interrupt_fit()
+            trainer.join(timeout=5.0)
         self.communication.stop()
         logger.unregister_node(self.addr)
         self._running = False

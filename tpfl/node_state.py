@@ -75,6 +75,18 @@ class NodeState:
         partial aggregate), and the cached stale bytes must not keep
         flowing. ``+=`` from concurrent handlers loses bumps, hence
         writes under ``relay_lock``; cache-key reads are lock-free."""
+        # guarded-by: relay_lock writes
+        self.model_round_origin: int = 0
+        """Model-version ORDINAL of the params the learner currently
+        holds — the round whose aggregate (or init, ordinal 0) they
+        came from. The async round lifecycle (Settings.ASYNC_ROUNDS)
+        tags every contribution with the ordinal its fit STARTED from;
+        the receiving aggregator's staleness weight ``w(τ)`` is keyed
+        off the distance between that tag and the round it folds into.
+        Monotonic max-bumps under ``relay_lock`` (same discipline as
+        ``last_full_model_round``); lock-free reads are one-ordinal
+        stale at worst, which only over-discounts a contribution by
+        one τ step."""
 
         # Gossip bookkeeping
         # guarded-by: models_aggregated_lock
@@ -217,6 +229,7 @@ class NodeState:
         with self.relay_lock:
             self.last_full_model_round = -1
             self.last_relayed_round = -1
+            self.model_round_origin = 0
         self.votes_ready_event.clear()
         self.aggregated_model_event.clear()
         self.wire_bases.clear()

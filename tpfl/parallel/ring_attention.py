@@ -338,7 +338,13 @@ def _ring_flash_fwd_core(q, k, v, axis_name, causal, block, interpret):
     from tpfl.parallel.flash_kernel import flash_block_fwd
 
     n = jax.lax.psum(1, axis_name)
-    my = jax.lax.axis_index(axis_name)
+    # axis_index only when the causal masking actually consumes it: the
+    # non-causal ring otherwise lowers a DEAD partition-id op inside
+    # the (un-DCE'd) custom_vjp call jaxpr, and XLA's SPMD sharding
+    # propagation — which flows from USERS — never marks a user-less
+    # instruction {manual}, so the partitioner rejects the whole
+    # sharded program ("PartitionId instruction is not supported").
+    my = jax.lax.axis_index(axis_name) if causal else None
     b, lq, h, d = q.shape
     perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -350,8 +356,8 @@ def _ring_flash_fwd_core(q, k, v, axis_name, causal, block, interpret):
 
     def body(t, carry):
         o, lse, kt, vt = carry
-        src = (my - t) % n
         if causal:
+            src = (my - t) % n
             # Diagonal step: causal within the block. Earlier blocks:
             # full attention. Future blocks: skipped at runtime.
             o, lse = jax.lax.cond(
@@ -396,7 +402,8 @@ def _ring_flash_vjp_bwd(axis_name, causal, block, interpret, res, g):
 
     q, k, v, out, lse = res
     n = jax.lax.psum(1, axis_name)
-    my = jax.lax.axis_index(axis_name)
+    # Same dead-partition-id guard as the forward core above.
+    my = jax.lax.axis_index(axis_name) if causal else None
     perm = [(i, (i + 1) % n) for i in range(n)]
     g32 = g.astype(jnp.float32)
     delta = jnp.einsum(
@@ -420,8 +427,8 @@ def _ring_flash_vjp_bwd(axis_name, causal, block, interpret, res, g):
 
     def body(t, carry):
         dq, kt, vt, dkt, dvt = carry
-        src = (my - t) % n
         if causal:
+            src = (my - t) % n
             dq, dkt, dvt = jax.lax.cond(
                 src == my,
                 lambda c: add(c, kt, vt, True),

@@ -241,6 +241,56 @@ class Settings:
     AGGREGATION_TIMEOUT: float = 300.0
     WAIT_HEARTBEATS_CONVERGENCE: float = 0.2
 
+    # --- asynchronous buffered rounds (FedBuff-style) ---
+    ASYNC_ROUNDS: bool = False
+    """Master gate for the asynchronous round lifecycle
+    (stages.base_node.AsyncRoundStage): every live peer trains
+    continuously and contributes whenever its fit finishes — no vote
+    election and no slowest-trainer barrier. Each node's aggregator
+    folds arrivals as a buffered FedBuff-style round
+    (``Aggregator.set_nodes_to_aggregate(async_k=...)``): a
+    contribution trained from model-version ordinal ``v`` folding into
+    round ``r`` carries staleness ``τ = r - v`` and weight
+    ``num_samples / (1 + τ)**ASYNC_STALENESS_EXP``; the round closes on
+    buffer-full (``ASYNC_BUFFER_K`` distinct contributors) or the
+    ``ASYNC_ROUND_DEADLINE`` failsafe — a dead trainer costs nothing
+    instead of AGGREGATION_TIMEOUT (the quorum-degradation economics,
+    without the barrier that made them necessary). Off (default):
+    the synchronous vote/train/wait lifecycle, reference parity.
+    See docs/protocol.md "Asynchronous buffered rounds"."""
+
+    ASYNC_BUFFER_K: int = 4
+    """Contributions (distinct contributors) that close an async
+    round's buffer — FedBuff's K. Clamped per round to the live peer
+    count; 1 is the degenerate fully-sequential buffer (every single
+    contribution makes a round)."""
+
+    ASYNC_STALENESS_EXP: float = 0.5
+    """Staleness-decay exponent: a contribution ``τ`` versions stale
+    folds at weight ``w(τ) = 1/(1+τ)**exp`` times its sample count.
+    0 disables staleness discounting (pure buffered FedAvg); 0.5 is
+    FedBuff's ``1/sqrt(1+τ)``; larger values silence stragglers
+    faster."""
+
+    ASYNC_ROUND_DEADLINE: float = 30.0
+    """Failsafe (s) on an async round staying open short of
+    ASYNC_BUFFER_K contributions: at the deadline the round closes
+    with whatever the buffer holds (``round_deadline`` flight event +
+    ``tpfl_agg_deadline_total``). An EMPTY buffer at the deadline
+    fails open loudly — the round stays open (there is nothing to
+    aggregate) and the stage re-arms the deadline."""
+
+    ASYNC_SERIALIZED: bool = True
+    """Deterministic async discipline (test/standalone profiles):
+    arrivals buffer without folding and the round-close fold runs in a
+    serialized deterministic order — schedule order when a seeded
+    :class:`tpfl.communication.faults.AsyncSchedule` is attached to
+    the aggregator (the reorder-buffer admission that makes same-seed
+    runs byte-identical, bench's async tier), else canonical
+    (contributor-sorted) order. False (scale profile): free-running —
+    contributions fold eagerly in arrival order (AGG_STREAM_EAGER
+    semantics), maximum throughput, no reproducibility guarantee."""
+
     # --- aggregation (streaming accumulators) ---
     AGG_STREAM_EAGER: bool = True
     """Fold contributions into the aggregator's on-device running
@@ -594,6 +644,15 @@ class Settings:
         cls.BREAKER_THRESHOLD = 3
         cls.BREAKER_PROBE_PERIOD = 1.0
         cls.ROUND_QUORUM = 1.0
+        # Async rounds off by default (reference-parity sync lifecycle);
+        # async tests/bench toggle per-case. Serialized discipline ON
+        # for this profile: deferred canonical folds (schedule order
+        # when one is attached) keep seeded async runs byte-identical.
+        cls.ASYNC_ROUNDS = False
+        cls.ASYNC_BUFFER_K = 4
+        cls.ASYNC_STALENESS_EXP = 0.5
+        cls.ASYNC_ROUND_DEADLINE = 15.0
+        cls.ASYNC_SERIALIZED = True
         # Telemetry off in tests by default: tracing tests toggle
         # per-case; the registry records regardless (it is cheap and
         # deterministic).
@@ -684,6 +743,14 @@ class Settings:
         cls.BREAKER_THRESHOLD = 3
         cls.BREAKER_PROBE_PERIOD = 15.0
         cls.ROUND_QUORUM = 1.0
+        # Async rounds opt-in here too; the patient deadline matches
+        # this profile's long protocol timeouts, and the serialized
+        # discipline keeps seeded runs reproducible.
+        cls.ASYNC_ROUNDS = False
+        cls.ASYNC_BUFFER_K = 4
+        cls.ASYNC_STALENESS_EXP = 0.5
+        cls.ASYNC_ROUND_DEADLINE = 120.0
+        cls.ASYNC_SERIALIZED = True
         # Tracing is an opt-in diagnostic (enable for a run you intend
         # to traceview); the ring and caps stay at class defaults.
         cls.TELEMETRY_ENABLED = False
@@ -805,6 +872,17 @@ class Settings:
         cls.BREAKER_THRESHOLD = 3
         cls.BREAKER_PROBE_PERIOD = 30.0
         cls.ROUND_QUORUM = 1.0
+        # Async rounds are opt-in even at scale (the sync lifecycle is
+        # the measured-baseline path), but when enabled this profile
+        # runs truly FREE-RUNNING: eager arrival-order folds, a wider
+        # buffer for the bigger fleets, and a deadline sized to the
+        # stall-window delivery bound (AGGREGATION_STALL's sizing rule
+        # applies to it unchanged).
+        cls.ASYNC_ROUNDS = False
+        cls.ASYNC_BUFFER_K = 8
+        cls.ASYNC_STALENESS_EXP = 0.5
+        cls.ASYNC_ROUND_DEADLINE = 60.0
+        cls.ASYNC_SERIALIZED = False
         # At 1000 in-process nodes every span append shares the GIL
         # with the federation itself: tracing stays off (the <5%
         # measured overhead is per-node, not per-host), the ring

@@ -146,6 +146,8 @@ class StartLearningStage(Stage):
             ),
         )
         time.sleep(Settings.WAIT_HEARTBEATS_CONVERGENCE)
+        if Settings.ASYNC_ROUNDS:
+            return AsyncRoundStage
         return VoteTrainSetStage
 
 
@@ -308,13 +310,14 @@ class TrainStage(Stage):
         # Replay partial models that arrived before this round opened
         # (stashed by PartialModelCommand; see NodeState.pending_partials).
         for args in st.drain_pending_partials(st.round):
-            source, rnd, weights, contributors, num_samples = args
+            source, rnd, weights, contributors, num_samples, version = args
             PartialModelCommand(node).execute(
                 source,
                 rnd,
                 weights=weights,
                 contributors=contributors,
                 num_samples=num_samples,
+                version=version,
             )
 
         TrainStage._evaluate(node)
@@ -540,6 +543,9 @@ class TrainStage(Stage):
                         st.last_full_model_round = max(
                             st.last_full_model_round, st.round
                         )
+                        st.model_round_origin = max(
+                            st.model_round_origin, st.round + 1
+                        )
                     # Register this round's delta-gossip base as the
                     # WIRE ROUND-TRIP of our aggregate, not the exact
                     # params: under a lossy codec a dense receiver holds
@@ -582,6 +588,243 @@ class TrainStage(Stage):
                 MetricsCommand.name, flat, round=node.state.round
             )
         )
+
+
+class AsyncRoundStage(Stage):
+    """FedBuff-style asynchronous buffered round
+    (``Settings.ASYNC_ROUNDS`` — selected by StartLearningStage /
+    RoundFinishedStage in place of the vote/train/wait lifecycle).
+
+    No election, no barrier: every live peer trains every round, each
+    contribution is pushed to all peers the moment its fit finishes
+    (tagged with the model-version ordinal it trained FROM), and each
+    node's aggregator folds arrivals as a buffered round that closes on
+    ``ASYNC_BUFFER_K`` distinct contributors or the
+    ``ASYNC_ROUND_DEADLINE`` failsafe — a trainer 10x slower than the
+    fleet delays nobody: its late contribution simply folds into a
+    later round at a staleness-discounted weight
+    (``aggregator.staleness_weight``). Under ``ASYNC_SERIALIZED`` (+ an
+    attached seeded AsyncSchedule) arrivals admit in a deterministic
+    schedule order and the fold is deferred to a canonical-order close,
+    which is what makes same-seed runs byte-identical; free-running
+    (scale profile) folds eagerly in arrival order. See
+    docs/protocol.md "Asynchronous buffered rounds"."""
+
+    name = "AsyncRoundStage"
+
+    @staticmethod
+    def execute(node: "Node") -> Optional[Type[Stage]]:
+        st = node.state
+        if check_early_stop(node):
+            return None
+        profiling.rounds.begin_round(node.addr, st.round)
+        # Every live peer is a trainer; the snapshot is bookkeeping,
+        # not an expectation — the aggregator grows it for late joiners
+        # and never waits on any specific member.
+        st.train_set = sorted(
+            set(node.communication.get_neighbors()) | {node.addr}
+        )
+        node.aggregator.set_nodes_to_aggregate(
+            st.train_set,
+            async_k=Settings.ASYNC_BUFFER_K,
+            round_ordinal=st.round if st.round is not None else 0,
+        )
+        if ledger.active():
+            ledger.contrib.open_round(
+                node.addr, st.round,
+                node.learner.get_model().get_parameters(),
+            )
+        # Contributions that arrived while the previous round's buffer
+        # was already closed were stashed — fold them into this round
+        # (their staleness tags, not their stash age, set their weight).
+        for args in st.drain_pending_partials(st.round):
+            source, rnd, weights, contributors, num_samples, version = args
+            PartialModelCommand(node).execute(
+                source,
+                rnd,
+                weights=weights,
+                contributors=contributors,
+                num_samples=num_samples,
+                version=version,
+            )
+
+        TrainStage._evaluate(node)
+        if check_early_stop(node):
+            node.aggregator.clear()
+            return None
+
+        if Settings.ASYNC_SERIALIZED:
+            # Deterministic discipline: ONE fit per round, inline on
+            # the learning thread, trained from the previous round's
+            # output — the contribution sequence is then a pure
+            # function of the seed, which is what the byte-determinism
+            # receipt needs. A slow trainer's round cadence is
+            # fit-bound here (its buffer still fills with peer
+            # contributions while it fits; they fold the moment its
+            # next round opens).
+            start_version = st.model_round_origin
+            # Batching hint for the in-process simulation pool: the K
+            # fastest trainers' round boundaries stay nearly
+            # synchronized (they all close on the same Kth
+            # contribution), so their fits co-batch into one vmapped
+            # program. Hint K — NOT the full train set: waiting for
+            # stragglers at the POOL would rebuild the very barrier
+            # this lifecycle removes (the pool dispatches a partial
+            # group after SIM_BATCH_MAX_WAIT regardless).
+            node.learner.set_fit_group_hint(
+                min(Settings.ASYNC_BUFFER_K, len(st.train_set))
+            )
+            logger.info(
+                node.addr,
+                f"Training async (round {st.round}, from v{start_version})",
+            )
+            with tracing.maybe_span(
+                "train_fit", node.addr,
+                round=st.round if st.round is not None else -1,
+            ):
+                fitted = node.learner.fit()
+            if check_early_stop(node):
+                node.aggregator.clear()
+                return None
+            AsyncRoundStage._contribute(node, fitted, start_version)
+        else:
+            # Free-running (the throughput configuration): the trainer
+            # loop runs on its OWN thread, fitting continuously at
+            # whatever pace this node manages and contributing each
+            # result the moment it exists — the round loop below
+            # advances on ARRIVALS, so a 10x-slower trainer's rounds
+            # tick at the fleet's cadence, not its fit time. This is
+            # the decoupling that actually removes the barrier: with
+            # an inline fit, a slow node's experiment wall-clock stays
+            # rounds x own-fit even though nobody waits for it.
+            AsyncRoundStage._ensure_trainer_loop(node)
+
+        # Wait for the buffer to fill — or the deadline failsafe. A
+        # failed-open empty-buffer deadline re-arms (our own fit is in
+        # flight through the intake; something will arrive).
+        deadline = time.monotonic() + Settings.ASYNC_ROUND_DEADLINE
+        with profiling.rounds.span(node.addr, "gossip"):
+            while not node.aggregator.wait_closed(
+                timeout=min(Settings.ROUND_WAIT_POLL, 0.25)
+            ):
+                if check_early_stop(node):
+                    node.aggregator.clear()
+                    return None
+                if time.monotonic() >= deadline:
+                    if node.aggregator.async_deadline_close():
+                        break
+                    deadline = (
+                        time.monotonic() + Settings.ASYNC_ROUND_DEADLINE
+                    )
+        try:
+            # The event is set — this computes the staleness-weighted
+            # fold without blocking.
+            agg_model = node.aggregator.wait_and_get_aggregation(
+                timeout=1.0
+            )
+        except NoModelsToAggregateError:
+            logger.error(node.addr, "Nothing aggregated this async round")
+            return RoundFinishedStage
+        except Exception as e:  # byzantine/malformed peer payloads
+            logger.error(node.addr, f"Async aggregation failed: {e}")
+            return RoundFinishedStage
+        node.learner.set_model(agg_model)
+        if st.round is not None:
+            with st.relay_lock:
+                st.last_full_model_round = max(
+                    st.last_full_model_round, st.round
+                )
+                st.model_round_origin = max(
+                    st.model_round_origin, st.round + 1
+                )
+        return RoundFinishedStage
+
+    @staticmethod
+    def _contribute(node: "Node", fitted, start_version: int) -> None:
+        """Fold one finished fit locally (through the same intake — and
+        the same reorder buffer, when one is attached — as every
+        peer's) and push it to every live peer. One single-contributor
+        payload, no partial-coverage exchange: coverage bookkeeping is
+        what the barrier needed; the buffer close condition does not."""
+        st = node.state
+        node.aggregator.add_model(fitted, start_version=start_version)
+        try:
+            payload = node.communication.model_payload(fitted)
+            try:
+                contributors = fitted.get_contributors()
+            except ValueError:
+                contributors = [node.addr]
+            msg = node.communication.build_weights(
+                PartialModelCommand.name,
+                st.round if st.round is not None else 0,
+                payload,
+                contributors=contributors,
+                num_samples=fitted.get_num_samples(),
+                version=start_version,
+            )
+            with profiling.rounds.span(node.addr, "gossip"):
+                for nei in list(st.train_set):
+                    if nei != node.addr:
+                        node.communication.send(
+                            nei, msg, create_connection=True
+                        )
+        except Exception as e:
+            logger.warning(
+                node.addr, f"Async contribution push failed: {e}"
+            )
+
+    @staticmethod
+    def _ensure_trainer_loop(node: "Node") -> None:
+        """Start (once per experiment) the free-running trainer thread:
+        fit continuously from whatever model the node currently holds,
+        tag each contribution with the version ordinal the fit STARTED
+        from, contribute, repeat. Exits when the experiment ends or
+        learning stops (``check_early_stop``); a new experiment starts
+        a fresh loop."""
+        import threading
+
+        alive = getattr(node, "_async_trainer_thread", None)
+        if alive is not None and alive.is_alive():
+            return
+        exp = node.state.exp_name
+
+        def loop() -> None:
+            st = node.state
+            while True:
+                if check_early_stop(node) or st.exp_name != exp:
+                    return
+                start_version = st.model_round_origin
+                node.learner.set_fit_group_hint(
+                    min(
+                        Settings.ASYNC_BUFFER_K,
+                        max(1, len(st.train_set)),
+                    )
+                )
+                try:
+                    t_fit = time.monotonic()
+                    with tracing.maybe_span(
+                        "train_fit", node.addr,
+                        round=st.round if st.round is not None else -1,
+                    ):
+                        fitted = node.learner.fit()
+                    profiling.rounds.add(
+                        node.addr, "train", time.monotonic() - t_fit
+                    )
+                except Exception as e:
+                    logger.error(
+                        node.addr, f"Async trainer fit failed: {e}"
+                    )
+                    return
+                if check_early_stop(node) or st.exp_name != exp:
+                    return
+                AsyncRoundStage._contribute(node, fitted, start_version)
+
+        node._async_trainer_thread = threading.Thread(
+            target=loop,
+            daemon=True,
+            name=f"async-trainer-{node.addr}",
+        )
+        node._async_trainer_thread.start()
 
 
 class WaitAggregatedModelsStage(Stage):
@@ -768,7 +1011,19 @@ class RoundFinishedStage(Stage):
         )
 
         if st.round is not None and st.total_rounds is not None and st.round < st.total_rounds:
+            if Settings.ASYNC_ROUNDS:
+                return AsyncRoundStage
             return VoteTrainSetStage
+
+        # Experiment done: release the free-running async trainer loop
+        # BEFORE clearing state — an in-flight fit returns early on the
+        # interrupt, the loop's next early-stop check sees the cleared
+        # experiment and exits (leaving it mid-fit into process
+        # teardown aborts inside XLA).
+        if Settings.ASYNC_ROUNDS:
+            trainer = getattr(node, "_async_trainer_thread", None)
+            if trainer is not None and trainer.is_alive():
+                node.learner.interrupt_fit()
 
         # Experiment done: final eval, back to idle (reference :66-74).
         TrainStage._evaluate(node)
