@@ -79,8 +79,11 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
   10x-slower 20% tail, sync-vs-async: async must beat the barrier'd
   sync lifecycle by >=1.5x rounds/sec at steady loss within 2%;
   async_determinism runs the SERIALIZED discipline (plan-seeded
-  AsyncSchedule reorder buffers) twice with one seed and asserts
-  byte-identical final global models across runs and across nodes.
+  AsyncSchedule reorder buffers) twice with one seed — with the
+  ADAPTIVE controller on (learning/async_control.py) — and asserts
+  byte-identical final global models across runs and across nodes,
+  plus identical per-node controller K/deadline trajectories. The
+  stale-flooding defense variant lives in extra.byzantine_async.
 
 - extra.profiling_*: device-plane observatory tier
   (management/profiling.py) — CompileObservatory recompile detection on
@@ -1217,6 +1220,13 @@ def _byzantine_tier(extra: dict) -> None:
     - extra.byzantine_ab: defense-off vs defense-on rounds/sec at the
       fault-free 4-node scale every observability tier measures its
       tax at — the interleaved best-of-3 discipline, shared 5% budget.
+    - extra.byzantine_async: the ASYNC variant — 20% replay adversaries
+      (stale_flood + withhold_replay, attacks/plan.py) buffer-stuffing
+      a 10-node serialized buffered-round federation: staleness-BLIND
+      aggregation (ASYNC_STALENESS_EXP=0, defense off) degrades, the
+      staleness-aware defended run (quarantine's stale_flood class +
+      the FedBuff discount) recovers >= 0.95x the adversary-free async
+      federation, and the quarantine set matches plan truth exactly.
     """
     from tpfl.management import ledger
     from tpfl.settings import Settings
@@ -1258,14 +1268,16 @@ def _byzantine_tier(extra: dict) -> None:
                     seed=seed,
                 )
 
-            def honest_acc(exp: str) -> float:
+            def honest_acc(exp: str, adv: "set | None" = None) -> float:
                 """Mean test accuracy over honest nodes across the last
                 two rounds (two rounds halve the per-node test-set
                 quantization noise on the CPU-sized federation)."""
                 tbl = metric_table(exp)
                 vals = []
                 for node in sorted(tbl):
-                    if int(node.rsplit("n", 1)[1]) in adv_idx:
+                    if int(node.rsplit("n", 1)[1]) in (
+                        adv_idx if adv is None else adv
+                    ):
                         continue
                     series = tbl[node].get("test_metric", [])
                     vals.extend(v for _, v in series[-2:])
@@ -1398,6 +1410,106 @@ def _byzantine_tier(extra: dict) -> None:
                 )
                 return time.monotonic() - t0
 
+            # --- async variant: stale-flooding under buffered rounds ---
+            # 20% replay adversaries (one stale_flood buffer-stuffing
+            # version-0 junk from round 1, one withhold_replay turning
+            # hostile at round 2 with a version-regressing tag) against
+            # a 10-node serialized async federation, K = fleet,
+            # ASYNC_STALENESS_MAX = 2 so the flood signature fires by
+            # round 3. Staleness-BLIND aggregation (exp = 0, defense
+            # off) folds the junk at full weight every round and
+            # measurably degrades; the staleness-aware defended run
+            # (quarantine + FedBuff discount) excludes it and recovers
+            # >= 0.95x the adversary-free async federation (the
+            # 8-honest-node ceiling — replayed peers' data cannot be
+            # recovered, only their junk excluded). The quarantine
+            # verdicts must match the plan's ground truth exactly.
+            async_adv_idx = {1, 4}
+
+            def async_attack_plan() -> AttackPlan:
+                return AttackPlan(
+                    {
+                        1: AttackSpec("stale_flood"),
+                        4: AttackSpec("withhold_replay", start=2),
+                    },
+                    seed=seed,
+                )
+
+            def run_async_arm(
+                attack: bool, defend: bool, blind: bool = False, n: int = 10
+            ) -> "tuple[float, list, dict]":
+                ledger.contrib.reset()
+                Settings.ASYNC_ROUNDS = True
+                Settings.ASYNC_SERIALIZED = True
+                Settings.ASYNC_ADAPTIVE = False
+                Settings.ASYNC_BUFFER_K = n
+                Settings.ASYNC_STALENESS_MAX = 2
+                Settings.ASYNC_STALENESS_EXP = 0.0 if blind else 0.5
+                Settings.QUARANTINE_ENABLED = defend
+                Settings.LEDGER_ENABLED = defend
+                Settings.TRAIN_SET_SIZE = n
+
+                def data_fn(s):
+                    from tpfl.learning.dataset import rendered_digits
+
+                    return rendered_digits(
+                        n_train=200 * n, n_test=1200, seed=s
+                    )
+
+                exp = run_seeded_experiment(
+                    seed + 1, n, 8, epochs=4,
+                    attack_plan=async_attack_plan() if attack else None,
+                    data_fn=data_fn,
+                    samples_per_node=200, batch_size=25,
+                    learning_rate=0.1, timeout=600.0,
+                )
+                replay = quarantine.replay_decisions() if defend else []
+                truth = adversary_map(exp) if attack else {}
+                return honest_acc(exp, async_adv_idx), replay, truth
+
+            a_ideal, _, _ = run_async_arm(attack=False, defend=False, n=8)
+            a_blind, _, _ = run_async_arm(
+                attack=True, defend=False, blind=True
+            )
+            a_def, a_replay, a_truth = run_async_arm(
+                attack=True, defend=True
+            )
+            a_flagged = {
+                a["peer"] for a in a_replay if a["action"] == "quarantine"
+            }
+            extra["byzantine_async"] = {
+                "seed": seed + 1,
+                "nodes": 10,
+                "rounds": 8,
+                "adversaries": sorted(a_truth),
+                "adversary_free_acc": round(a_ideal, 4),
+                "stale_blind_acc": round(a_blind, 4),
+                "defended_acc": round(a_def, 4),
+                "blind_ratio": ratio(a_blind, a_ideal),
+                "defended_ratio": ratio(a_def, a_ideal),
+                "flagged": sorted(a_flagged),
+                "stale_flood_reasons": bool(
+                    a_flagged
+                    and all(
+                        "stale_flood" in a["reasons"]
+                        for a in a_replay
+                        if a["action"] == "quarantine"
+                    )
+                ),
+                # "Measurably degrades": the blind fold lands solidly
+                # below the defended one on the SAME attacked run (the
+                # most drift-stable comparison; measured 0.94 vs the
+                # 0.98 gate) — the defended arm's own floor is gated
+                # against the adversary-free ceiling below.
+                "stale_degrades": bool(a_blind <= 0.98 * a_def),
+                "defended_recovers": bool(a_def >= 0.95 * a_ideal),
+                "quarantine_exact": bool(a_flagged == set(a_truth)),
+            }
+            # Restore the SYNC lifecycle for the A/B below.
+            Settings.ASYNC_ROUNDS = False
+            Settings.ASYNC_STALENESS_EXP = 0.5
+            Settings.ASYNC_STALENESS_MAX = 16
+
             run_ab(True)  # warm
             off_times, on_times = [], []
             for _ in range(3):
@@ -1444,7 +1556,10 @@ def _async_tier(extra: dict) -> None:
       buffer at every aggregator) must end with byte-identical global
       models, both across the two runs and across every node within a
       run (the fold sequence is position-deterministic, so all nodes
-      converge on identical bytes).
+      converge on identical bytes). The adaptive controller
+      (ASYNC_ADAPTIVE) is ON for these runs: its per-node K/deadline
+      trajectories — derived from the schedule's virtual clock — must
+      also come out identical.
     """
     from tpfl.settings import Settings
 
@@ -1544,10 +1659,15 @@ def _async_tier(extra: dict) -> None:
             # global contribution sequence, so the staleness-weighted
             # folds produce identical bytes at every node and in every
             # run.
-            def run_det() -> "dict[str, str]":
+            def run_det() -> "tuple[dict[str, str], dict]":
                 Settings.ASYNC_ROUNDS = True
                 Settings.ASYNC_BUFFER_K = 8
                 Settings.ASYNC_SERIALIZED = True
+                # The adaptive controller rides the determinism receipt:
+                # serialized-mode observations come from the schedule's
+                # VIRTUAL clock, so the per-node K/deadline trajectories
+                # must also be byte-identical across same-seed runs.
+                Settings.ASYNC_ADAPTIVE = True
                 # Bit-exactness needs FIXED program shapes: the
                 # batching pool's vmap bucket width follows whoever
                 # co-submits (timing-dependent), and XLA compiles a
@@ -1561,9 +1681,11 @@ def _async_tier(extra: dict) -> None:
                     speed_plan=speed_plan(),
                     samples_per_node=100, batch_size=25, timeout=600.0,
                 )
-                return final_model_digests(exp)
+                from tpfl.attacks.harness import controller_trajectories
 
-            d1, d2 = run_det(), run_det()
+                return final_model_digests(exp), controller_trajectories(exp)
+
+            (d1, t1), (d2, t2) = run_det(), run_det()
             extra["async_determinism"] = {
                 "byte_identical": bool(
                     d1 == d2 and len(set(d1.values())) == 1
@@ -1571,6 +1693,9 @@ def _async_tier(extra: dict) -> None:
                 "runs_match": bool(d1 == d2),
                 "nodes_converged_identical": len(set(d1.values())) == 1,
                 "digest": sorted(set(d1.values()))[:1],
+                "controller_trajectories_identical": bool(
+                    t1 == t2 and all(t1.values())
+                ),
             }
         finally:
             Settings.restore(snap)

@@ -754,3 +754,119 @@ def test_eager_stream_fold_error_falls_back_to_batch():
     with pytest.raises(ValueError, match="delta_y_i"):
         agg.wait_and_get_aggregation(timeout=5)
     agg.clear()
+
+
+# --- staleness-aware robust aggregation (async buffered rounds) ------------
+
+
+def stream_fold_stale(agg, models_taus):
+    st = agg.acc_init(models_taus[0][0])
+    for m, tau in models_taus:
+        st = agg.accumulate(st, m, staleness=tau)
+    return agg.finalize(st)
+
+
+def test_krum_rejects_candidates_past_staleness_max():
+    """Boundary semantics: τ == max is kept, τ == max + 1 is rejected
+    before scoring — a replayed old model can't win the selection just
+    by sitting inside its own version's honest cluster."""
+    from tpfl.settings import Settings
+
+    Settings.ASYNC_STALENESS_MAX = 3
+    # The stale candidate is the tightest cluster member — staleness-
+    # blind Krum would select it.
+    fresh = [(mk_model(1.0, 1, ["a"]), 0), (mk_model(1.2, 2, ["b"]), 1),
+             (mk_model(1.4, 1, ["c"]), 3)]  # boundary τ: kept
+    stale = (mk_model(1.1, 9, ["old"]), 4)  # τ > max: rejected
+    out = stream_fold_stale(Krum("t", n_byzantine=0), fresh + [stale])
+    val = float(np.asarray(out.get_parameters()["w"])[0, 0])
+    assert val in (1.0, 1.2, 1.4)  # never the rejected 1.1
+    # Coverage metadata still carries every contributor.
+    assert out.get_contributors() == ["a", "b", "c", "old"]
+
+
+def test_trimmedmean_all_stale_fails_open():
+    """A buffer saturated by stale candidates must not brick the round:
+    the staleness shrink fails open to the full (quarantine-kept)
+    buffer with a loud warning."""
+    from tpfl.settings import Settings
+
+    Settings.ASYNC_STALENESS_MAX = 2
+    models = [(mk_model(v, 1, [c]), 5) for v, c in
+              [(1.0, "a"), (2.0, "b"), (3.0, "c")]]
+    out = stream_fold_stale(TrimmedMean("t", trim=0), models)
+    val = float(np.asarray(out.get_parameters()["w"])[0, 0])
+    assert val == pytest.approx(2.0)  # plain mean of all three
+
+
+def test_multikrum_staleness_discounts_selected_weights():
+    """Multi-Krum's final average applies the FedBuff discount to each
+    selected model's sample mass: w_i = num_samples * (1+τ)^-exp."""
+    from tpfl.learning.aggregators.aggregator import staleness_weight
+    from tpfl.settings import Settings
+
+    Settings.ASYNC_STALENESS_MAX = 16
+    Settings.ASYNC_STALENESS_EXP = 0.5
+    models = [(mk_model(1.0, 10, ["a"]), 0), (mk_model(3.0, 10, ["b"]), 3)]
+    out = stream_fold_stale(MultiKrum("t", n_byzantine=0, m=2), models)
+    w_a = 10 * staleness_weight(0)
+    w_b = 10 * staleness_weight(3)
+    val = float(np.asarray(out.get_parameters()["w"])[0, 0])
+    assert val == pytest.approx((1.0 * w_a + 3.0 * w_b) / (w_a + w_b),
+                                rel=1e-5)
+
+
+def test_krum_staleness_penalty_breaks_cluster_ties():
+    """Two candidates equidistant from the cluster: the τ-stale one's
+    score inflates by (1+τ)^exp and the fresh one is selected."""
+    from tpfl.settings import Settings
+
+    Settings.ASYNC_STALENESS_MAX = 16
+    Settings.ASYNC_STALENESS_EXP = 1.0
+    # Evenly spaced chain: the stale end and the fresh end have EQUAL
+    # blind scores (each is 0.02 from its nearest neighbor) — the
+    # (1+τ)^exp penalty must strictly order the fresh one first.
+    models = [(mk_model(1.0, 1, ["stale"]), 8), (mk_model(1.02, 1, ["fresh"]), 0),
+              (mk_model(1.04, 1, ["c"]), 0)]
+    agg = Krum("t", n_byzantine=0)
+    st = agg.acc_init(models[0][0])
+    for m, tau in models:
+        st = agg.accumulate(st, m, staleness=tau)
+    kept = list(range(3))
+    scores = np.asarray(agg._scores(st, kept))
+    assert scores[1] < scores[0]  # fresh twin beats stale twin
+
+
+def test_robust_streaming_mixed_tau_order_independent():
+    """Streaming equivalence with a mixed-τ reservoir: permuted arrival
+    orders produce the identical trimmed mean (the (candidate, τ)
+    multiset — not the interleaving — determines the fold)."""
+    from tpfl.settings import Settings
+
+    Settings.ASYNC_STALENESS_MAX = 4
+    entries = [(mk_model(0.0, 1, ["a"]), 0), (mk_model(1.0, 1, ["b"]), 2),
+               (mk_model(2.0, 1, ["c"]), 4), (mk_model(99.0, 1, ["d"]), 5)]
+    out1 = stream_fold_stale(TrimmedMean("t", trim=0), entries)
+    out2 = stream_fold_stale(TrimmedMean("t", trim=0), list(reversed(entries)))
+    np.testing.assert_array_equal(
+        np.asarray(out1.get_parameters()["w"]),
+        np.asarray(out2.get_parameters()["w"]),
+    )
+    # The τ=5 candidate was rejected: mean of the kept three.
+    val = float(np.asarray(out1.get_parameters()["w"])[0, 0])
+    assert val == pytest.approx(1.0)
+
+
+def test_robust_sync_rounds_bit_identical_to_staleness_blind():
+    """τ = 0 everywhere (every sync round): the staleness machinery is
+    inert — selection and bytes match the plain streaming fold."""
+    models = [mk_model(1.0, 1, ["a"]), mk_model(1.2, 2, ["b"]),
+              mk_model(1.4, 3, ["c"]), mk_model(50.0, 1, ["evil"])]
+    blind = stream_fold(Krum("t", n_byzantine=1), models)
+    aware = stream_fold_stale(
+        Krum("t", n_byzantine=1), [(m, 0) for m in models]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(blind.get_parameters()["w"]),
+        np.asarray(aware.get_parameters()["w"]),
+    )

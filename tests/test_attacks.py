@@ -300,3 +300,79 @@ def test_seeded_reproducibility():
             last_err = err
             print(f"seeded-repro pair mismatch (attempt {attempt}): {err}")
     raise last_err
+
+
+# --- async replay attacks (stale_flood / withhold_replay) -------------------
+
+
+def test_replay_attack_specs_parse_and_name():
+    from tpfl.attacks import AttackPlan, AttackSpec
+
+    plan = AttackPlan.from_dict(
+        {
+            "seed": 3,
+            "peers": {
+                "f": {"attack": "stale_flood"},
+                "w": {"attack": "withhold_replay", "start": 2, "end": 5},
+            },
+        }
+    )
+    assert plan.spec_for("f").name == "stale_flood"
+    assert plan.spec_for("w").name == "withhold_replay"
+    truth = plan.adversary_map(["f", "w", "h"])
+    assert truth == {"f": "stale_flood", "w": "withhold_replay"}
+    # Replay modes never touch the numbers — poison() is the identity.
+    params = {"w": np.ones((2, 2), np.float32)}
+    out = plan.poison("f", 1, plan.spec_for("f"), params)
+    assert out is params
+
+
+def test_stale_flood_adversary_replays_first_contribution():
+    """Active window with a cache: fit() skips the real training and
+    shape_contribution re-sends the cached (params, version) pair."""
+    from tpfl.attacks import AttackPlan, AttackSpec, PlannedAdversary
+    from tpfl.learning.jax_learner import JaxLearner
+
+    inner = JaxLearner(
+        model=_model_fn(0), data=_data_fn(0), addr="flood-adv", batch_size=50
+    )
+    plan = AttackPlan(
+        {"flood-adv": AttackSpec("stale_flood")}, seed=3
+    )
+    adv = PlannedAdversary(inner, plan)
+    adv.set_epochs(1)
+    # Round 0: no cache yet — honest fit, cached at the contribute seam.
+    m0 = adv.fit()
+    shaped0, v0 = adv.shape_contribution(m0, 0)
+    assert shaped0 is m0 and v0 == 0  # pass-through + cache
+    # Round 1: replay — no real fit, old params, old version tag.
+    m1 = adv.fit()
+    shaped1, v1 = adv.shape_contribution(m1, 5)
+    assert v1 == 0  # the cached tag, NOT the current version
+    for a, b in zip(
+        shaped1.get_parameters_list(), m0.get_parameters_list()
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_withhold_replay_regresses_version_after_honest_rounds():
+    """Honest until start (versions advance), then the replayed first
+    contribution's tag regresses below tags already sent."""
+    from tpfl.attacks import AttackPlan, AttackSpec, PlannedAdversary
+    from tpfl.learning.jax_learner import JaxLearner
+
+    inner = JaxLearner(
+        model=_model_fn(0), data=_data_fn(0), addr="wr-adv", batch_size=50
+    )
+    plan = AttackPlan(
+        {"wr-adv": AttackSpec("withhold_replay", start=2)}, seed=3
+    )
+    adv = PlannedAdversary(inner, plan)
+    adv.set_epochs(1)
+    versions = []
+    for rnd in range(4):
+        m = adv.fit()
+        _, v = adv.shape_contribution(m, rnd)
+        versions.append(v)
+    # Rounds 0-1 honest (tags advance with the round), 2+ replay v0.
+    assert versions == [0, 1, 0, 0]

@@ -70,6 +70,7 @@ GUARDED_MODULES = (
     "tpfl/management/quarantine.py",
     "tpfl/learning/aggregators/aggregator.py",
     "tpfl/learning/aggregators/robust.py",
+    "tpfl/learning/async_control.py",
     "tpfl/attacks/attacks.py",
     "tpfl/attacks/plan.py",
     "tpfl/parallel/engine.py",

@@ -35,6 +35,7 @@ from tpfl.attacks.attacks import (
 from tpfl.attacks.harness import (
     adversary_map,
     assert_tables_allclose,
+    controller_trajectories,
     flatten_table,
     metric_table,
     run_seeded_experiment,
@@ -64,6 +65,7 @@ __all__ = [
     "apply_speed_plan",
     "run_seeded_experiment",
     "adversary_map",
+    "controller_trajectories",
     "metric_table",
     "flatten_table",
     "assert_tables_allclose",

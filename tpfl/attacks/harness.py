@@ -40,6 +40,22 @@ _FINAL_DIGESTS: dict[str, dict[str, str]] = {}
 def final_model_digests(exp_name: str) -> dict[str, str]:
     """``{addr: sha256(params)}`` captured at experiment finish."""
     return dict(_FINAL_DIGESTS.get(exp_name, {}))
+
+
+#: Per-experiment adaptive-controller trajectories:
+#: ``exp_name -> {addr: [{round, k, deadline, ...}, ...]}`` captured
+#: before teardown — the K/deadline determinism receipt (two same-seed
+#: serialized runs must produce identical trajectories at every node).
+_CTL_TRAJECTORIES: dict[str, dict[str, list]] = {}
+
+
+def controller_trajectories(exp_name: str) -> dict[str, list]:
+    """``{addr: per-round controller decisions}`` captured at
+    experiment finish (empty for runs without ASYNC_ADAPTIVE)."""
+    return {
+        k: [dict(r) for r in v]
+        for k, v in _CTL_TRAJECTORIES.get(exp_name, {}).items()
+    }
 from tpfl.learning.dataset import RandomIIDPartitionStrategy, rendered_digits
 from tpfl.management.logger import logger
 from tpfl.models import create_model
@@ -182,6 +198,18 @@ def run_seeded_experiment(
                 h.update(leaf_bytes(np.asarray(leaf)))
             digests[node.addr] = h.hexdigest()
         _FINAL_DIGESTS[exp_name] = digests
+        # Adaptive-controller trajectory receipt (empty lists when
+        # ASYNC_ADAPTIVE was off — the controller records nothing).
+        # Experiment teardown (RoundFinishedStage -> state.clear) has
+        # already reset the controller by the time the last node
+        # finishes, so read the archived log when the live one is gone.
+        _CTL_TRAJECTORIES[exp_name] = {
+            node.addr: (
+                node.state.async_controller.trajectory()
+                or node.state.async_controller.last_trajectory()
+            )
+            for node in nodes
+        }
         return exp_name
     finally:
         for node in nodes:

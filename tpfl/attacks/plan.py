@@ -26,8 +26,17 @@ Schema (:meth:`AttackPlan.from_dict`)::
      "peers": {"node-3": {"attack": "sign_flip"},
                "node-6": {"attack": "additive_noise", "std": 0.1,
                            "mode": "ramp", "start": 2, "ramp_rounds": 3},
+               "node-7": {"attack": "stale_flood"},
+               "node-8": {"attack": "withhold_replay", "start": 2,
+                           "end": 5},
                "1":      {"attack": "sign_flip", "mode": "once",
                            "start": 0}}}
+
+The async buffer-stuffing modes (``stale_flood`` / ``withhold_replay``
+— see :data:`REPLAY_ATTACKS`) poison the freshness METADATA instead of
+the parameters: the adversary replays an old contribution under its
+old version tag, instantly, to crowd honest arrivals out of the
+buffered round's K slots.
 
 Peer keys are node addresses, or integer indices resolved against the
 node list at :func:`apply_attack_plan` time (the harness's seeded
@@ -43,8 +52,25 @@ from typing import Any, Iterable, Optional
 from tpfl.attacks.attacks import AdversarialLearner
 from tpfl.settings import Settings
 
-ATTACKS = ("sign_flip", "additive_noise")
+ATTACKS = ("sign_flip", "additive_noise", "stale_flood", "withhold_replay")
 MODES = ("always", "once", "ramp")
+
+#: Async buffer-stuffing attacks: the adversary caches its FIRST
+#: contribution (params + the version ordinal it trained from) and,
+#: while the schedule is active, REPLAYS it instead of fitting —
+#: instantly, so the junk contribution races honest trainers into the
+#: K-slot buffer. ``stale_flood`` starts at round 0 by convention: the
+#: replayed tag's staleness ``τ`` grows without bound (the
+#: implausible-τ signature). ``withhold_replay`` starts later
+#: (``start > 0``): the peer first contributes honestly at advancing
+#: versions, then replays the old one — a version REGRESSION
+#: (``tpfl.management.ledger``'s ``stale_flood`` anomaly class catches
+#: both). Parameters are never numerically poisoned; the attack is on
+#: the freshness metadata and the buffer economy, which is why
+#: staleness-BLIND aggregation folds it at full weight. Async rounds
+#: only (sync rounds have no version tags); in a sync lifecycle the
+#: replay degrades to re-sending stale params.
+REPLAY_ATTACKS = ("stale_flood", "withhold_replay")
 
 
 @dataclass
@@ -144,6 +170,10 @@ class AttackPlan:
         alpha = spec.strength(round)
         if alpha <= 0.0:
             return params
+        if spec.attack in REPLAY_ATTACKS:
+            # Replay modes poison the freshness TAG, not the numbers —
+            # PlannedAdversary.shape_contribution carries the attack.
+            return params
         import jax
         import jax.numpy as jnp
 
@@ -189,7 +219,11 @@ class PlannedAdversary(AdversarialLearner):
     """Round-aware model-poisoning adversary driven by an
     :class:`AttackPlan`: every ``fit()`` trains honestly, then applies
     the plan's scheduled attack (if any) for this peer at this fit
-    ordinal. Pure delegation otherwise (see AdversarialLearner)."""
+    ordinal. The :data:`REPLAY_ATTACKS` modes additionally skip the
+    real fit while active (a flooder's edge is being FAST) and rewrite
+    the contribution through :meth:`shape_contribution` — the seam
+    ``AsyncRoundStage._contribute`` offers every learner. Pure
+    delegation otherwise (see AdversarialLearner)."""
 
     def __init__(self, inner: Any, plan: AttackPlan, index: Optional[int] = None) -> None:
         super().__init__(inner, attack=lambda p: p)
@@ -199,18 +233,81 @@ class PlannedAdversary(AdversarialLearner):
         # per round on the learning thread.
         # unguarded: only the learning thread calls fit().
         self._round = 0
+        # Replay cache: (params, contributors, num_samples, version) of
+        # this peer's FIRST contribution — what the replay modes
+        # re-send. Written once at the first shape_contribution call.
+        # unguarded: only the learning thread fits/contributes.
+        self._replay_cache: "tuple | None" = None
+
+    def _spec(self) -> Optional[AttackSpec]:
+        return self._plan.spec_for(self.get_addr(), self._index)
 
     def fit(self):
+        spec = self._spec()
+        if (
+            spec is not None
+            and spec.attack in REPLAY_ATTACKS
+            and spec.strength(self._round) > 0.0
+            and self._replay_cache is not None
+        ):
+            # Active replay window with a cached contribution: no real
+            # fit at all — the junk re-send is near-instant, which is
+            # exactly how it crowds honest arrivals out of the buffer.
+            self._round += 1
+            params, contributors, num_samples, _v = self._replay_cache
+            model = self._inner.get_model().build_copy(
+                params=params,
+                contributors=list(contributors),
+                num_samples=num_samples,
+            )
+            self._last_fit_model = model
+            return model
         model = self._inner.fit()
         rnd, self._round = self._round, self._round + 1
         addr = self.get_addr()
-        spec = self._plan.spec_for(addr, self._index)
         if spec is not None and spec.strength(rnd) > 0.0:
             model.set_parameters(
                 self._plan.poison(addr, rnd, spec, model.get_parameters())
             )
         self._last_fit_model = model
         return model
+
+    def shape_contribution(self, model: Any, version: int) -> "tuple[Any, int]":
+        """Async contribution seam (``AsyncRoundStage._contribute``):
+        the replay modes substitute the cached first contribution AND
+        its original version tag — the receiver sees either an
+        implausibly-stale τ (stale_flood) or a version regressing below
+        tags this peer already sent (withhold_replay). Honest (and
+        non-replay) contributions pass through, caching the first one
+        seen."""
+        spec = self._spec()
+        if spec is None or spec.attack not in REPLAY_ATTACKS:
+            return model, version
+        # The fit ordinal that produced `model` (fit() already advanced
+        # the counter).
+        rnd = max(0, self._round - 1)
+        if spec.strength(rnd) > 0.0 and self._replay_cache is not None:
+            params, contributors, num_samples, v0 = self._replay_cache
+            return (
+                model.build_copy(
+                    params=params,
+                    contributors=list(contributors),
+                    num_samples=num_samples,
+                ),
+                int(v0),
+            )
+        if self._replay_cache is None:
+            try:
+                contributors = model.get_contributors()
+            except ValueError:
+                contributors = [self.get_addr()]
+            self._replay_cache = (
+                model.get_parameters(),
+                list(contributors),
+                model.get_num_samples(),
+                int(version),
+            )
+        return model, version
 
 
 def apply_attack_plan(nodes: "list[Any]", plan: AttackPlan) -> dict[str, str]:

@@ -432,6 +432,13 @@ class AsyncSchedule:
         (None for an empty schedule)."""
         return self._heap[0][2] if self._heap else None
 
+    def expected_time(self) -> Optional[float]:
+        """The head contribution's VIRTUAL finish time — the seeded
+        clock the adaptive controller's serialized-mode observations
+        derive from (same-seed runs see identical stamps regardless of
+        real arrival timing)."""
+        return self._heap[0][0] if self._heap else None
+
     def advance(self) -> None:
         """Consume the head (its contribution was admitted) and
         schedule that trainer's next contribution."""

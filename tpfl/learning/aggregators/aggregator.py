@@ -51,6 +51,24 @@ def staleness_weight(tau: int) -> float:
     return float((1.0 + float(tau)) ** -exp)
 
 
+def untagged_staleness() -> "int | None":
+    """Effective staleness ordinal of an UNTAGGED async contribution
+    (``Message.version == -1`` — a pre-async peer, or a spoofing
+    adversary stripping the tag to dodge the staleness discount),
+    per ``Settings.ASYNC_UNTAGGED_POLICY``: "fresh" → 0 (reference
+    parity), "max-stale" → ``ASYNC_STALENESS_MAX`` (the heaviest
+    discount that still folds), "reject" → None (the intake refuses
+    the contribution). One resolution point so the fold weight, the
+    robust candidates' τ and the quarantine/ledger window all see the
+    same number."""
+    policy = str(Settings.ASYNC_UNTAGGED_POLICY)
+    if policy == "reject":
+        return None
+    if policy == "max-stale":
+        return max(0, int(Settings.ASYNC_STALENESS_MAX))
+    return 0
+
+
 def stack_models(models: list[TpflModel]) -> tuple[Any, jnp.ndarray]:
     """Stack N parameter pytrees along a leading node axis and return the
     per-model sample counts — one fused XLA op per leaf instead of a
@@ -171,6 +189,20 @@ class Aggregator(ABC):
         self._async_sched: Any = None
         # guarded-by: _lock
         self._async_hold: dict[str, list] = {}
+        # Per-round (τ, stamp) arrival observations for the adaptive
+        # control plane (tpfl.learning.async_control): stamp is the
+        # AsyncSchedule VIRTUAL time for schedule-drained admissions,
+        # the arrival ordinal in serialized mode without a schedule,
+        # and time.monotonic() free-running. Drained by the stage via
+        # take_arrival_observations() at round close.
+        # guarded-by: _lock
+        self._arrivals: list[tuple[int, float]] = []
+        # Deadline attempt ordinal for the open async round: bumped on
+        # every async_deadline_close() call while the round stays open,
+        # so repeated empty-buffer fail-open re-arms are countable
+        # (round_deadline events carry it as `attempt`).
+        # guarded-by: _lock
+        self._deadline_attempt: int = 0
         self._lock = make_lock("Aggregator._lock")
         self._finish_aggregation_event = threading.Event()
         self._finish_aggregation_event.set()
@@ -226,8 +258,17 @@ class Aggregator(ABC):
         raise NotImplementedError
 
     def accumulate(
-        self, state: AggStream, model: TpflModel, weight: "float | None" = None
+        self,
+        state: AggStream,
+        model: TpflModel,
+        weight: "float | None" = None,
+        staleness: int = 0,
     ) -> AggStream:
+        """``staleness``: the contribution's async version-distance τ
+        (0 for sync rounds). Mean-family aggregators ignore it — their
+        discount already rides ``weight`` — but the robust family
+        records it per candidate so finalize can reject/discount stale
+        slots (``Settings.ASYNC_STALENESS_MAX``)."""
         raise NotImplementedError
 
     def finalize(self, state: AggStream) -> TpflModel:
@@ -291,6 +332,8 @@ class Aggregator(ABC):
             self._excluded = {}
             self._staleness = {}
             self._close_reason = None
+            self._arrivals = []
+            self._deadline_attempt = 0
             self._async_k = (
                 max(1, min(int(async_k), len(nodes))) if async_k else 0
             )
@@ -450,6 +493,12 @@ class Aggregator(ABC):
             if not self._async_k:
                 return False
             held = bool(self._models)
+            # Attempt ordinal: monotonically increasing across the
+            # open round's repeated empty-buffer fail-open re-arms, so
+            # a flooded/partitioned node cycling its deadline is
+            # countable instead of emitting indistinguishable events.
+            self._deadline_attempt += 1
+            attempt = self._deadline_attempt
             if held:
                 self._close_reason = "deadline"
                 self._finish_aggregation_event.set()
@@ -468,14 +517,19 @@ class Aggregator(ABC):
             "round_deadline", self.node_name,
             outcome="closed" if held else "empty",
             round=self._round_ordinal,
+            attempt=attempt,
         )
         if not held:
+            logger.metrics.counter(
+                "tpfl_agg_deadline_rearm_total",
+                labels={"node": self.node_name},
+            )
             logger.warning(
                 self.node_name,
                 f"Async round {self._round_ordinal} deadline expired with "
-                "an EMPTY buffer; failing open (round stays open, "
-                "deadline re-arms) — no contribution, not even our own "
-                "fit, has arrived",
+                f"an EMPTY buffer (attempt {attempt}); failing open "
+                "(round stays open, deadline re-arms) — no contribution, "
+                "not even our own fit, has arrived",
             )
             return False
         self._emit_async_close("deadline")
@@ -492,6 +546,8 @@ class Aggregator(ABC):
             self._excluded = {}
             self._staleness = {}
             self._close_reason = None
+            self._arrivals = []
+            self._deadline_attempt = 0
             self.version += 1
         self._finish_aggregation_event.set()
         # Drop the ledger's round reference/accumulator (unconditional:
@@ -514,12 +570,24 @@ class Aggregator(ABC):
     def _staleness_of(self, start_version: "int | None") -> int:
         """Staleness ordinal of a contribution trained from model
         version ``start_version`` folding into the round being formed
-        (0 for untagged contributions and for synchronous rounds).
+        (0 for synchronous rounds; untagged async contributions resolve
+        through :func:`untagged_staleness` — "reject" is enforced by
+        add_model before this runs, so the fallback here is fresh).
         Lock-free reads of the write-guarded ordinals (stale read =
         one ordinal of drift on a value that only ever grows)."""
-        if start_version is None or not self._async_k:
+        if not self._async_k:
             return 0
+        if start_version is None:
+            return untagged_staleness() or 0
         return max(0, int(self._round_ordinal) - int(start_version))
+
+    def take_arrival_observations(self) -> "list[tuple[int, float]]":
+        """Drain the open/last round's (τ, stamp) arrival observations
+        — the adaptive controller's per-round feed (stamps: schedule
+        virtual time / arrival ordinal / monotonic, see _arrivals)."""
+        with self._lock:
+            out, self._arrivals = self._arrivals, []
+        return out
 
     def add_model(
         self,
@@ -545,6 +613,25 @@ class Aggregator(ABC):
             contributors = model.get_contributors()
         except ValueError:
             logger.debug(self.node_name, "Dropping model with no contributors")
+            return []
+        if (
+            self._async_k
+            and start_version is None
+            and untagged_staleness() is None
+        ):
+            # ASYNC_UNTAGGED_POLICY == "reject": a contribution without
+            # a version tag is refused at intake — loudly, so a fleet
+            # of pre-async peers meeting a strict profile is visible
+            # instead of silently starving the buffer.
+            logger.metrics.counter(
+                "tpfl_agg_untagged_rejected_total",
+                labels={"node": self.node_name},
+            )
+            logger.debug(
+                self.node_name,
+                f"Dropping untagged contribution from {contributors} "
+                "(ASYNC_UNTAGGED_POLICY=reject)",
+            )
             return []
         staleness = self._staleness_of(start_version)
         # Active-defense verdict BEFORE the fold (outside _lock — the
@@ -627,12 +714,17 @@ class Aggregator(ABC):
             if not queue:
                 break
             model, start_version, exclude, trace, recorded = queue.pop(0)
+            # Virtual-clock stamp of this admission (the controller's
+            # serialized observation source) — read before advance()
+            # consumes the head.
+            vt = sched.expected_time()
             # The schedule slot is consumed whether or not the round's
             # coverage checks accept the model — every node sees the
             # same sequence, so the rejection is identical everywhere.
             sched.advance()
             covered = self._admit_locked(
-                model, [exp], exclude=exclude, start_version=start_version
+                model, [exp], exclude=exclude, start_version=start_version,
+                virtual_stamp=vt,
             )
             admitted.append(
                 (
@@ -703,9 +795,13 @@ class Aggregator(ABC):
         contributors: list[str],
         exclude: bool = False,
         start_version: "int | None" = None,
+        virtual_stamp: "float | None" = None,
     ) -> "list[str] | None":
         """Caller holds ``_lock``: the coverage checks + fold
-        bookkeeping of one contribution."""
+        bookkeeping of one contribution. ``virtual_stamp``: the
+        AsyncSchedule virtual-clock time of a schedule-drained
+        admission (the controller's deterministic observation
+        source)."""
         if self._finish_aggregation_event.is_set():
             logger.debug(
                 self.node_name, "Dropping model: no aggregation in progress"
@@ -762,9 +858,19 @@ class Aggregator(ABC):
         self._models.append(model)
         tau = 0
         if self._async_k:
-            if start_version is not None:
-                tau = max(0, self._round_ordinal - int(start_version))
+            tau = self._staleness_of(start_version)
             self._staleness[id(model)] = tau
+            # Arrival observation for the adaptive control plane:
+            # virtual clock (schedule-drained) > arrival ordinal
+            # (serialized, no schedule — still deterministic per
+            # multiset) > monotonic (free-running, real cadence).
+            if virtual_stamp is not None:
+                stamp = float(virtual_stamp)
+            elif Settings.ASYNC_SERIALIZED:
+                stamp = float(len(self._arrivals))
+            else:
+                stamp = time.monotonic()
+            self._arrivals.append((tau, stamp))
         # Eager folds: sync rounds follow Settings.AGG_STREAM_EAGER;
         # async rounds fold eagerly only when FREE-RUNNING
         # (ASYNC_SERIALIZED off) — the serialized discipline defers
@@ -815,11 +921,14 @@ class Aggregator(ABC):
                     self._stream = self.acc_init(model)
                 if self._async_k:
                     # Staleness-discounted fold weight (FedBuff):
-                    # sample mass decayed by the version distance.
+                    # sample mass decayed by the version distance; τ
+                    # itself rides along for the robust family's
+                    # candidate bookkeeping (ASYNC_STALENESS_MAX).
                     self._stream = self.accumulate(
                         self._stream, model,
                         weight=model.get_num_samples()
                         * staleness_weight(tau),
+                        staleness=tau,
                     )
                 else:
                     self._stream = self.accumulate(self._stream, model)
@@ -950,6 +1059,7 @@ class Aggregator(ABC):
                             state, m,
                             weight=m.get_num_samples()
                             * staleness_weight(staleness.get(id(m), 0)),
+                            staleness=staleness.get(id(m), 0),
                         )
                     out = self.finalize(state)
                 else:

@@ -88,8 +88,14 @@ class FedAvg(Aggregator):
         return AggStream(template)
 
     def accumulate(
-        self, state: AggStream, model: TpflModel, weight: "float | None" = None
+        self,
+        state: AggStream,
+        model: TpflModel,
+        weight: "float | None" = None,
+        staleness: int = 0,
     ) -> AggStream:
+        # staleness is metadata for the robust family; the mean's
+        # discount already rides `weight` (staleness_weight x samples).
         w = jnp.float32(
             model.get_num_samples() if weight is None else weight
         )

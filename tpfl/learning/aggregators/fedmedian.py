@@ -52,7 +52,11 @@ class FedMedian(Aggregator):
         return st
 
     def accumulate(
-        self, state: AggStream, model: TpflModel, weight: "float | None" = None
+        self,
+        state: AggStream,
+        model: TpflModel,
+        weight: "float | None" = None,
+        staleness: int = 0,
     ) -> AggStream:
         reservoir: list = state.extra["reservoir"]
         cap = max(1, int(Settings.AGG_MEDIAN_RESERVOIR))

@@ -34,6 +34,20 @@ finalize — a peer quarantined AFTER its contribution was buffered is
 dropped before Krum scoring / the trimmed sort, defense-in-depth on top
 of the intake-time exclusion in ``Aggregator.add_model``.
 
+Staleness-aware (async buffered rounds): every buffered candidate
+carries its version-distance ``τ`` (``accumulate(..., staleness=)``,
+threaded by the aggregator's async folds). At finalize, candidates
+past ``Settings.ASYNC_STALENESS_MAX`` are REJECTED before any scoring
+(boundary τ == max is kept; an all-stale buffer fails open loudly — a
+stale-flooding adversary must not brick the round it tried to crowd),
+Krum/MultiKrum selection scores are PENALIZED by ``(1+τ)^exp`` (among
+otherwise-close candidates the fresher wins — distance scoring alone
+is blind to a replayed old model that sits inside the honest cluster
+of ITS OWN version), and Multi-Krum's final average discounts each
+selected model's sample weight by ``staleness_weight(τ)`` exactly like
+the mean family. Sync rounds see τ = 0 everywhere and all three
+mechanisms reduce to the PR-8 behavior bit-for-bit.
+
 Preconditions are validated, not silently clamped: Krum requires
 ``n >= 2f + 3`` (Blanchard et al. 2017, Thm. 1) — an under-provisioned
 candidate set logs a warning and bumps
@@ -56,7 +70,11 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from tpfl.learning.aggregators.aggregator import Aggregator, AggStream
+from tpfl.learning.aggregators.aggregator import (
+    Aggregator,
+    AggStream,
+    staleness_weight,
+)
 from tpfl.learning.model import TpflModel
 from tpfl.management.logger import logger
 from tpfl.settings import Settings
@@ -140,13 +158,18 @@ class _RobustStream(Aggregator):
         st.extra["peers"] = []  # contributor tuple per slot
         st.extra["weights"] = []  # num_samples per slot
         st.extra["params"] = []  # parameter pytree per slot
+        st.extra["taus"] = []  # staleness ordinal per slot (async τ)
         st.extra["rng"] = random.Random(
             (Settings.SEED or 0) ^ zlib.crc32(self.node_name.encode())
         )
         return st
 
     def accumulate(
-        self, state: AggStream, model: TpflModel, weight: "float | None" = None
+        self,
+        state: AggStream,
+        model: TpflModel,
+        weight: "float | None" = None,
+        staleness: int = 0,
     ) -> AggStream:
         cap = max(1, int(Settings.AGG_ROBUST_BUFFER))
         peers = state.extra["peers"]
@@ -155,6 +178,7 @@ class _RobustStream(Aggregator):
             peers.append(tuple(sorted(model.get_contributors())))
             state.extra["weights"].append(int(model.get_num_samples()))
             state.extra["params"].append(model.get_parameters())
+            state.extra["taus"].append(int(staleness))
         else:
             # Vitter's algorithm R (the FedMedian discipline): every
             # candidate seen so far has equal probability of occupying
@@ -165,6 +189,7 @@ class _RobustStream(Aggregator):
                 peers[slot] = tuple(sorted(model.get_contributors()))
                 state.extra["weights"][slot] = int(model.get_num_samples())
                 state.extra["params"][slot] = model.get_parameters()
+                state.extra["taus"][slot] = int(staleness)
             else:
                 slot = None
         if slot is not None:
@@ -181,34 +206,66 @@ class _RobustStream(Aggregator):
         raise NotImplementedError
 
     def _kept_slots(self, state: AggStream) -> list[int]:
-        """Candidate slots surviving the quarantine shrink: verdicts
-        that landed after a contribution was buffered drop it before
-        any scoring. Fail-open (all slots kept, loud warning) when the
-        shrink would empty the candidate set — a defense never bricks
-        the round."""
+        """Candidate slots surviving the finalize-time shrinks, applied
+        in order: (1) quarantine verdicts that landed after a
+        contribution was buffered, (2) staleness rejection — async
+        candidates whose ``τ`` exceeds ``Settings.ASYNC_STALENESS_MAX``
+        (boundary τ == max is kept; negative max disables). Each shrink
+        fails open independently (all its input slots kept, loud
+        warning) when it would empty the candidate set — a defense (or
+        a stale-flooding adversary saturating one) never bricks the
+        round."""
         peers = state.extra["peers"]
+        kept = list(range(len(peers)))
         quarantined = self.quarantined_peers()
-        if not quarantined:
-            return list(range(len(peers)))
-        kept = [
-            i
-            for i, p in enumerate(peers)
-            if not (set(p) & quarantined)
-        ]
-        if not kept and peers:
-            logger.warning(
-                self.node_name,
-                f"Quarantine would drop every {type(self).__name__} "
-                "candidate; failing open to the full buffer",
-            )
-            return list(range(len(peers)))
-        if len(kept) < len(peers):
-            logger.metrics.counter(
-                "tpfl_agg_candidates_shrunk_total",
-                labels={"node": self.node_name},
-                value=len(peers) - len(kept),
-            )
+        if quarantined:
+            clean = [
+                i for i in kept if not (set(peers[i]) & quarantined)
+            ]
+            if not clean and kept:
+                logger.warning(
+                    self.node_name,
+                    f"Quarantine would drop every {type(self).__name__} "
+                    "candidate; failing open to the full buffer",
+                )
+            else:
+                if len(clean) < len(kept):
+                    logger.metrics.counter(
+                        "tpfl_agg_candidates_shrunk_total",
+                        labels={"node": self.node_name},
+                        value=len(kept) - len(clean),
+                    )
+                kept = clean
+        max_tau = int(Settings.ASYNC_STALENESS_MAX)
+        taus = state.extra.get("taus") or []
+        if max_tau >= 0 and any(
+            taus[i] > max_tau for i in kept if i < len(taus)
+        ):
+            fresh = [
+                i for i in kept if i < len(taus) and taus[i] <= max_tau
+            ]
+            if not fresh:
+                logger.warning(
+                    self.node_name,
+                    f"Every {type(self).__name__} candidate is past "
+                    f"ASYNC_STALENESS_MAX ({max_tau}); failing open to "
+                    "the quarantine-kept buffer — a stale flood must "
+                    "not brick the round",
+                )
+            else:
+                logger.metrics.counter(
+                    "tpfl_agg_stale_rejected_total",
+                    labels={"node": self.node_name},
+                    value=len(kept) - len(fresh),
+                )
+                kept = fresh
         return kept
+
+    def _kept_taus(self, state: AggStream, kept: list[int]) -> list[int]:
+        """Per-kept-slot staleness ordinals (0-padded for robustness
+        against pre-τ state built by older accumulate paths)."""
+        taus = state.extra.get("taus") or []
+        return [taus[i] if i < len(taus) else 0 for i in kept]
 
     def finalize(self, state: AggStream) -> TpflModel:
         if not state.extra.get("peers"):
@@ -253,12 +310,26 @@ class Krum(_RobustStream):
 
     def _scores(self, state: AggStream, kept: list[int]):
         """Krum scores over the kept candidate rows (host-side index
-        pick; the scoring itself is the one jitted Gram matmul)."""
+        pick; the scoring itself is the one jitted Gram matmul), with
+        the staleness penalty: a τ-stale candidate's score inflates by
+        ``(1+τ)^ASYNC_STALENESS_EXP`` — pairwise distance is blind to a
+        replayed old model sitting inside the honest cluster of its
+        own version, so freshness breaks the tie. τ = 0 everywhere
+        (sync rounds) multiplies by exactly 1.0 — bit-identical
+        selection to the staleness-blind scoring."""
         n = len(state.extra["peers"])
         flat = state.extra["flat"][:n]
         if len(kept) < n:
             flat = flat[jnp.asarray(kept, jnp.int32)]
-        return _krum_scores(flat, self.n_byzantine)
+        scores = _krum_scores(flat, self.n_byzantine)
+        taus = self._kept_taus(state, kept)
+        if any(taus):
+            exp = float(Settings.ASYNC_STALENESS_EXP)
+            penalty = jnp.asarray(
+                [(1.0 + float(t)) ** exp for t in taus], jnp.float32
+            )
+            scores = scores * penalty
+        return scores
 
     def _finalize_kept(self, state: AggStream, kept: list[int]) -> TpflModel:
         self._check_preconditions(len(kept))
@@ -304,9 +375,16 @@ class MultiKrum(Krum):
             _acc_update,
         )
 
+        taus = state.extra.get("taus") or []
         acc = None
         for i in sorted(selected):  # canonical fold order
-            w = jnp.float32(state.extra["weights"][i])
+            # Sample weight discounted by the candidate's staleness —
+            # the FedBuff rule the mean family already applies; τ = 0
+            # (sync) multiplies by exactly 1.0.
+            tau = taus[i] if i < len(taus) else 0
+            w = jnp.float32(
+                state.extra["weights"][i] * staleness_weight(tau)
+            )
             p = state.extra["params"][i]
             acc = _acc_first(p, w) if acc is None else _acc_update(acc, p, w)
         avg = _acc_finalize(acc, state.template.get_parameters())

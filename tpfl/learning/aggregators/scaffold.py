@@ -98,7 +98,11 @@ class Scaffold(Aggregator):
         return AggStream(template)
 
     def accumulate(
-        self, state: AggStream, model: TpflModel, weight: "float | None" = None
+        self,
+        state: AggStream,
+        model: TpflModel,
+        weight: "float | None" = None,
+        staleness: int = 0,
     ) -> AggStream:
         state.offered += 1
         # Skipped fits (num_samples == 0 — interrupted/lapped trainers)

@@ -241,11 +241,27 @@ class AnomalyScorer:
       ``N(0, std)`` noise over d parameters adds ``std·√d`` of update
       norm — tens of robust sigmas above the honest cluster at the
       harness defaults.
+    - **stale flood** (async buffered rounds — the
+      ``tpfl/attacks/plan.py`` ``stale_flood`` / ``withhold_replay``
+      signatures): a contribution whose staleness ``τ`` exceeds
+      ``Settings.ASYNC_STALENESS_MAX`` (implausibly stale — honest
+      stragglers sit at single-digit τ) or whose version ordinal
+      REGRESSES below one the same peer already contributed (a peer's
+      start version is monotonic by construction: it only advances as
+      aggregates are adopted — regression means a replayed old
+      contribution). Needs no norm baseline, so a flooder is flagged
+      the moment its τ crosses the bound. Disabled when
+      ``ASYNC_STALENESS_MAX`` is negative; sync rounds (τ = 0
+      everywhere, versions = rounds) never trip it.
     """
 
     @staticmethod
     def score(
-        update_norm: float, cos_ref: float, window: "list[float]"
+        update_norm: float,
+        cos_ref: float,
+        window: "list[float]",
+        staleness: int = 0,
+        version_regressed: bool = False,
     ) -> "tuple[bool, list[str], float]":
         """(flagged, reasons, z_norm)."""
         reasons: list[str] = []
@@ -257,6 +273,9 @@ class AnomalyScorer:
             and z >= float(Settings.LEDGER_ANOMALY_Z)
         ):
             reasons.append("norm_outlier")
+        max_tau = int(Settings.ASYNC_STALENESS_MAX)
+        if max_tau >= 0 and (int(staleness) > max_tau or version_regressed):
+            reasons.append("stale_flood")
         return bool(reasons), reasons, z
 
 
@@ -321,6 +340,15 @@ class ContributionLedger:
         # the previous experiment's reference).
         # guarded-by: _lock
         self._last_open: dict[str, int] = {}
+        # Per-(observer node, peer) max version ordinal seen — the
+        # version-REGRESSION baseline of the stale_flood signature
+        # (a peer's start version is monotonic by construction, so a
+        # lower tag than one it already contributed is a replay).
+        # Observer-independent in value: the version reconstructs the
+        # contribution's own start ordinal. Cleared with the score
+        # cache on experiment restart.
+        # guarded-by: _lock
+        self._peer_version: dict[tuple, int] = {}
 
     # --- lifecycle ---
 
@@ -333,6 +361,7 @@ class ContributionLedger:
                 self._score_cache.clear()
                 self._score_keys.clear()
                 self._last_open.clear()
+                self._peer_version.clear()
             self._last_open[node] = rnd
             self._open[node] = {
                 "round": rnd,
@@ -468,6 +497,18 @@ class ContributionLedger:
                     and e["update_norm"] is not None
                 ):
                     return e  # re-push of an already-scored contribution
+            # Version-regression check BEFORE the watermark updates:
+            # the contribution's own start ordinal (round − τ, observer-
+            # independent) against the max this observer has seen from
+            # the peer — a lower tag is a replayed old contribution
+            # (the withhold_replay signature).
+            version = st["round"] - int(staleness)
+            vkey = (node, peer)
+            prev_version = self._peer_version.get(vkey)
+            regressed = prev_version is not None and version < prev_version
+            self._peer_version[vkey] = (
+                version if prev_version is None else max(prev_version, version)
+            )
             cached = self._score_cache.get((peer, st["round"]))
             if cached is not None:
                 # Another observer already ran this contribution's
@@ -484,7 +525,6 @@ class ContributionLedger:
                 # Sync rounds have staleness 0 everywhere, so version
                 # == round and this is bit-identical to the historical
                 # prior-rounds filter.
-                version = st["round"] - int(staleness)
                 window = [
                     x["update_norm"]
                     for x in ring
@@ -502,7 +542,8 @@ class ContributionLedger:
                 scalars = np.asarray(scalars_dev, np.float64)
                 update_norm = float(scalars[0])
                 flagged, reasons, z_norm = AnomalyScorer.score(
-                    update_norm, float(scalars[2]), window
+                    update_norm, float(scalars[2]), window,
+                    staleness=staleness, version_regressed=regressed,
                 )
                 scored = {
                     "update_norm": update_norm,
@@ -558,8 +599,24 @@ class ContributionLedger:
             )
             for ring in rings:
                 window: "list[float] | None" = None
+                # Ring-order version watermark per peer: the regression
+                # half of the stale_flood signature for the passive
+                # (flush-at-close) path.
+                seen_version: dict[str, int] = {}
                 for e in ring:
                     params = e.pop("_params", None)
+                    version = e.get("version")
+                    prev_v = (
+                        seen_version.get(e["peer"])
+                        if e.get("single")
+                        else None
+                    )
+                    if e.get("single") and version is not None:
+                        seen_version[e["peer"]] = (
+                            version
+                            if prev_v is None
+                            else max(prev_v, version)
+                        )
                     if params is None:
                         continue
                     st = self._open.get(e["node"])
@@ -589,7 +646,13 @@ class ContributionLedger:
                         for x in np.asarray(leaf_dev, np.float64)
                     ]
                     flagged, reasons, z_norm = AnomalyScorer.score(
-                        e["update_norm"], e["cos_ref"], window
+                        e["update_norm"], e["cos_ref"], window,
+                        staleness=e.get("staleness", 0),
+                        version_regressed=bool(
+                            prev_v is not None
+                            and version is not None
+                            and version < prev_v
+                        ),
                     )
                     e["z_norm"] = round(z_norm, 4)
                     e["flagged"] = flagged
@@ -770,11 +833,25 @@ class ContributionLedger:
         baseline = [e["update_norm"] for e in dedup.values()]
         flagged: dict[str, dict] = {}
         scored = []
+        # Per-peer version watermark over the (peer, round)-sorted
+        # walk: within a peer, rounds ascend, so "max version at any
+        # EARLIER round" is a running max — deterministic regardless
+        # of which observers recorded which entry.
+        max_version: dict[str, int] = {}
         for (peer, rnd) in sorted(dedup):
             e = dedup[(peer, rnd)]
             window = [x for x in baseline]
+            version = e.get("version", rnd)
+            prev_v = max_version.get(peer)
+            max_version[peer] = (
+                version if prev_v is None else max(prev_v, version)
+            )
             is_flagged, reasons, z = AnomalyScorer.score(
-                e["update_norm"], e["cos_ref"], window
+                e["update_norm"], e["cos_ref"], window,
+                staleness=e.get("staleness", 0),
+                version_regressed=bool(
+                    prev_v is not None and version < prev_v
+                ),
             )
             scored.append(
                 {
@@ -782,6 +859,8 @@ class ContributionLedger:
                     "round": rnd,
                     "update_norm": round(e["update_norm"], 6),
                     "cos_ref": round(e["cos_ref"], 6),
+                    "staleness": int(e.get("staleness", 0)),
+                    "version": int(version),
                     "z_norm": round(z, 4),
                     "flagged": is_flagged,
                     "reasons": reasons,
@@ -806,6 +885,7 @@ class ContributionLedger:
             self._score_cache.clear()
             self._score_keys.clear()
             self._last_open.clear()
+            self._peer_version.clear()
 
 
 # --- convergence monitor --------------------------------------------------

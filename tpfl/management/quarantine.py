@@ -48,7 +48,12 @@ receipt).
 Threat model boundary (docs/robustness.md): the engine defends against
 **model-poisoning** adversaries that otherwise follow the protocol
 (the ``tpfl/attacks`` threat model — sign-flip / additive-noise local
-updates). A protocol-level Byzantine peer that forges partial
+updates) and, in async buffered rounds, against **freshness-metadata**
+adversaries (``stale_flood`` / ``withhold_replay`` — replayed
+old-version contributions buffer-stuffed to crowd honest arrivals;
+the ledger flags implausible staleness and version regression as the
+``stale_flood`` anomaly class and the same exclusion machinery
+applies). A protocol-level Byzantine peer that forges partial
 aggregates with fabricated contributor lists is out of scope; that
 needs signed per-contribution attestations, not statistics.
 

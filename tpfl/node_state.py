@@ -137,6 +137,18 @@ class NodeState:
         # unguarded: QuarantineEngine is internally synchronized (own
         # _lock); the reference itself is written once here.
         self.quarantine = QuarantineEngine(addr)
+
+        # Adaptive async control plane (tpfl.learning.async_control):
+        # AsyncRoundStage consults it at every async round open and
+        # feeds it the closed round's arrival observations. Static
+        # knob passthrough while Settings.ASYNC_ADAPTIVE is off; its
+        # learned state (EWMAs, trajectory) belongs to one experiment
+        # and resets with the rest of the learning state (clear()).
+        from tpfl.learning.async_control import AsyncController
+
+        # unguarded: AsyncController is internally synchronized (own
+        # _lock); the reference itself is written once here.
+        self.async_controller = AsyncController(addr)
         # unguarded: handler threads add(), the learning thread tests
         # membership and replaces the set wholesale at round
         # boundaries — all GIL-atomic set ops on a best-effort hint
@@ -247,6 +259,7 @@ class NodeState:
             self.nei_status = {}
         self.model_initialized_event.clear()
         self.quarantine.reset()
+        self.async_controller.reset()
 
     def __repr__(self) -> str:
         return (

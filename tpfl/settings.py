@@ -291,6 +291,76 @@ class Settings:
     contributions fold eagerly in arrival order (AGG_STREAM_EAGER
     semantics), maximum throughput, no reproducibility guarantee."""
 
+    ASYNC_ADAPTIVE: bool = False
+    """Adaptive async control plane (tpfl.learning.async_control
+    .AsyncController): when on, each node tunes its EFFECTIVE buffer K
+    and round deadline per round from the observed inter-arrival and
+    staleness distributions (EWMA over per-round order-invariant
+    summaries + the ASYNC_CTL_QUANTILE inter-arrival quantile), bounded
+    by [ASYNC_K_MIN, ASYNC_K_MAX] and (0, ASYNC_ROUND_DEADLINE].
+    ASYNC_BUFFER_K / ASYNC_ROUND_DEADLINE become the starting point and
+    the deadline ceiling instead of static values. In serialized mode
+    the controller's observations derive from the seeded AsyncSchedule
+    VIRTUAL clock (arrival ordinals without one), so same-seed runs
+    keep byte-identical K/deadline trajectories at every node; free-
+    running observations use the monotonic clock. Off (default): the
+    PR-10 static knobs, bit-identical behavior."""
+
+    ASYNC_K_MIN: int = 2
+    """Lower bound on the adaptive controller's effective buffer K
+    (ASYNC_ADAPTIVE). K=1 degenerates to a fully-sequential buffer
+    where any single flooder makes a round — 2 keeps at least one
+    honest arrival in every defended round's fold."""
+
+    ASYNC_K_MAX: int = 16
+    """Upper bound on the adaptive controller's effective buffer K
+    (further clamped per round to the live fleet size). A K at the
+    fleet size is the synchronous barrier again — the controller grows
+    toward this only while buffers fill fast and staleness stays low."""
+
+    ASYNC_CTL_EWMA: float = 0.3
+    """EWMA smoothing factor for the controller's per-round observation
+    summaries (inter-arrival quantile, mean staleness, fill time):
+    ``s <- (1-a)*s + a*x``. Higher = reacts faster to fleet changes,
+    lower = steadier knobs. Only read when ASYNC_ADAPTIVE."""
+
+    ASYNC_CTL_QUANTILE: float = 0.9
+    """Inter-arrival quantile the controller's deadline targets: the
+    effective deadline covers ``K`` arrivals at this quantile of the
+    observed inter-arrival distribution (x a fixed 4x safety margin),
+    clamped to ASYNC_ROUND_DEADLINE. 0.9 tolerates a 10% arrival tail
+    without deadline-closing the round. Only read when ASYNC_ADAPTIVE."""
+
+    ASYNC_STALENESS_MAX: int = 16
+    """Staleness plausibility bound, two consumers: (1) the robust
+    aggregators (Krum/MultiKrum/TrimmedMean) REJECT buffered candidates
+    whose ``τ`` exceeds it at finalize (boundary τ == max is kept;
+    all-rejected fails open loudly — a defense never bricks a round);
+    (2) the anomaly scorer flags contributions past it — or whose
+    version ordinal REGRESSES below one the same peer already
+    contributed — as ``stale_flood``, the buffer-stuffing attack
+    signature (tpfl.attacks.plan: stale_flood / withhold_replay), which
+    the quarantine engine then excludes like any other anomaly class.
+    Negative disables both. Honest stragglers sit at single-digit τ in
+    every measured configuration; 16 is far past the staleness-weight
+    floor (w(16) ≈ 0.24 at the default exp) where a contribution stops
+    mattering anyway."""
+
+    ASYNC_UNTAGGED_POLICY: str = "fresh"
+    """Freshness semantics for UNTAGGED contributions
+    (``Message.version == -1``: pre-async peers, or a spoofing
+    adversary omitting the tag to bypass staleness weighting):
+    "fresh" — τ=0, full weight (reference-parity default: a pre-async
+    peer is not penalized); "max-stale" — τ = ASYNC_STALENESS_MAX, the
+    most-discounted weight that still folds (the scale default:
+    untagged mass cannot dominate a buffer); "reject" — refused at
+    intake with ``tpfl_agg_untagged_rejected_total`` (strict
+    deployments where every peer is known to tag). The policy applies
+    to the staleness weight, the robust candidates' τ, and the
+    quarantine/ledger window the same way — one resolved τ per
+    contribution. Sync rounds ignore it (every sync contribution is
+    τ=0 by construction)."""
+
     # --- aggregation (streaming accumulators) ---
     AGG_STREAM_EAGER: bool = True
     """Fold contributions into the aggregator's on-device running
@@ -653,6 +723,16 @@ class Settings:
         cls.ASYNC_STALENESS_EXP = 0.5
         cls.ASYNC_ROUND_DEADLINE = 15.0
         cls.ASYNC_SERIALIZED = True
+        # Adaptive control off in tests (static PR-10 knobs = reference
+        # behavior); controller tests toggle per-case. Untagged
+        # contributions stay fresh for parity with pre-async peers.
+        cls.ASYNC_ADAPTIVE = False
+        cls.ASYNC_K_MIN = 2
+        cls.ASYNC_K_MAX = 16
+        cls.ASYNC_CTL_EWMA = 0.3
+        cls.ASYNC_CTL_QUANTILE = 0.9
+        cls.ASYNC_STALENESS_MAX = 16
+        cls.ASYNC_UNTAGGED_POLICY = "fresh"
         # Telemetry off in tests by default: tracing tests toggle
         # per-case; the registry records regardless (it is cheap and
         # deterministic).
@@ -751,6 +831,16 @@ class Settings:
         cls.ASYNC_STALENESS_EXP = 0.5
         cls.ASYNC_ROUND_DEADLINE = 120.0
         cls.ASYNC_SERIALIZED = True
+        # Adaptive control is an opt-in diagnostic here (like tracing):
+        # a handful of nodes on one host rarely needs tuned knobs, and
+        # static knobs keep seeded runs reference-comparable.
+        cls.ASYNC_ADAPTIVE = False
+        cls.ASYNC_K_MIN = 2
+        cls.ASYNC_K_MAX = 16
+        cls.ASYNC_CTL_EWMA = 0.3
+        cls.ASYNC_CTL_QUANTILE = 0.9
+        cls.ASYNC_STALENESS_MAX = 16
+        cls.ASYNC_UNTAGGED_POLICY = "fresh"
         # Tracing is an opt-in diagnostic (enable for a run you intend
         # to traceview); the ring and caps stay at class defaults.
         cls.TELEMETRY_ENABLED = False
@@ -883,6 +973,20 @@ class Settings:
         cls.ASYNC_STALENESS_EXP = 0.5
         cls.ASYNC_ROUND_DEADLINE = 60.0
         cls.ASYNC_SERIALIZED = False
+        # Free-running fleets are what the adaptive controller is FOR:
+        # the static K/deadline that fit a 10-node bench fleet starve
+        # or barrier a 1000-node one, so when async is enabled at scale
+        # the knobs tune themselves from the observed arrival cadence.
+        # Untagged contributions fold at the maximum discount — at this
+        # scale an untagged (or tag-stripping) minority must not carry
+        # full-weight mass into every buffer.
+        cls.ASYNC_ADAPTIVE = True
+        cls.ASYNC_K_MIN = 2
+        cls.ASYNC_K_MAX = 32
+        cls.ASYNC_CTL_EWMA = 0.3
+        cls.ASYNC_CTL_QUANTILE = 0.9
+        cls.ASYNC_STALENESS_MAX = 16
+        cls.ASYNC_UNTAGGED_POLICY = "max-stale"
         # At 1000 in-process nodes every span append shares the GIL
         # with the federation itself: tracing stays off (the <5%
         # measured overhead is per-node, not per-host), the ring

@@ -624,9 +624,21 @@ class AsyncRoundStage(Stage):
         st.train_set = sorted(
             set(node.communication.get_neighbors()) | {node.addr}
         )
+        # Adaptive control plane (Settings.ASYNC_ADAPTIVE): the node's
+        # AsyncController re-derives the effective (K, deadline) pair
+        # from the previous rounds' observed arrival/staleness
+        # distributions; static knob passthrough while off.
+        ctl = getattr(node.state, "async_controller", None)
+        if ctl is not None:
+            eff_k, eff_deadline = ctl.round_open(
+                st.round if st.round is not None else 0, len(st.train_set)
+            )
+        else:
+            eff_k = Settings.ASYNC_BUFFER_K
+            eff_deadline = Settings.ASYNC_ROUND_DEADLINE
         node.aggregator.set_nodes_to_aggregate(
             st.train_set,
-            async_k=Settings.ASYNC_BUFFER_K,
+            async_k=eff_k,
             round_ordinal=st.round if st.round is not None else 0,
         )
         if ledger.active():
@@ -671,9 +683,7 @@ class AsyncRoundStage(Stage):
             # stragglers at the POOL would rebuild the very barrier
             # this lifecycle removes (the pool dispatches a partial
             # group after SIM_BATCH_MAX_WAIT regardless).
-            node.learner.set_fit_group_hint(
-                min(Settings.ASYNC_BUFFER_K, len(st.train_set))
-            )
+            node.learner.set_fit_group_hint(min(eff_k, len(st.train_set)))
             logger.info(
                 node.addr,
                 f"Training async (round {st.round}, from v{start_version})",
@@ -699,10 +709,13 @@ class AsyncRoundStage(Stage):
             # rounds x own-fit even though nobody waits for it.
             AsyncRoundStage._ensure_trainer_loop(node)
 
-        # Wait for the buffer to fill — or the deadline failsafe. A
-        # failed-open empty-buffer deadline re-arms (our own fit is in
-        # flight through the intake; something will arrive).
-        deadline = time.monotonic() + Settings.ASYNC_ROUND_DEADLINE
+        # Wait for the buffer to fill — or the deadline failsafe (the
+        # controller-tuned effective deadline; the static knob when
+        # adaptation is off). A failed-open empty-buffer deadline
+        # re-arms at the same width (our own fit is in flight through
+        # the intake; something will arrive), with the re-arm count
+        # riding the aggregator's round_deadline events.
+        deadline = time.monotonic() + eff_deadline
         with profiling.rounds.span(node.addr, "gossip"):
             while not node.aggregator.wait_closed(
                 timeout=min(Settings.ROUND_WAIT_POLL, 0.25)
@@ -713,9 +726,17 @@ class AsyncRoundStage(Stage):
                 if time.monotonic() >= deadline:
                     if node.aggregator.async_deadline_close():
                         break
-                    deadline = (
-                        time.monotonic() + Settings.ASYNC_ROUND_DEADLINE
-                    )
+                    deadline = time.monotonic() + eff_deadline
+        # Feed the closed round's arrival observations back to the
+        # controller BEFORE the aggregation math (the observations are
+        # complete at close; the fold can take a while).
+        if ctl is not None:
+            ctl.observe_round(
+                st.round,
+                node.aggregator.take_arrival_observations(),
+                node.aggregator.close_reason(),
+                eff_deadline,
+            )
         try:
             # The event is set — this computes the staleness-weighted
             # fold without blocking.
@@ -747,6 +768,14 @@ class AsyncRoundStage(Stage):
         payload, no partial-coverage exchange: coverage bookkeeping is
         what the barrier needed; the buffer close condition does not."""
         st = node.state
+        # Contribution-shaping seam: a learner may rewrite the outgoing
+        # (model, version tag) pair — the attack harness's replay
+        # adversaries (tpfl.attacks.plan stale_flood/withhold_replay)
+        # ride it to send old-version contributions; plain learners
+        # don't implement it.
+        shape = getattr(node.learner, "shape_contribution", None)
+        if shape is not None:
+            fitted, start_version = shape(fitted, start_version)
         node.aggregator.add_model(fitted, start_version=start_version)
         try:
             payload = node.communication.model_payload(fitted)
