@@ -826,7 +826,7 @@ def _telemetry_tier(extra: dict) -> None:
 TIERS = (
     "primary", "resnet", "attention", "transformer", "sim1000",
     "multichip", "wire", "serde", "chaos", "analysis", "telemetry",
-    "profiling", "ledger", "byzantine", "async",
+    "profiling", "ledger", "byzantine", "async", "engine_obs",
 )
 
 
@@ -1194,6 +1194,205 @@ def _ledger_tier(extra: dict) -> None:
             ledger.convergence.reset()
     except Exception as e:
         extra["ledger_error"] = str(e)[:200]
+
+
+def _engine_obs_tier(extra: dict) -> None:
+    """Engine-plane telemetry tier (the ENGINE_TELEMETRY carry +
+    management/engine_obs fan-out). Three reports:
+
+    - extra.engine_obs_program: the program-split mechanics —
+      ``ENGINE_TELEMETRY=False`` lowers a STABLE HLO digest across a
+      telemetry toggle (the carry is elided, not masked; the
+      program-cache key splits), ``=True`` lowers a different program,
+      and same-seed ``run_rounds`` model bytes agree off-vs-on (the
+      carry is read-only).
+    - extra.engine_obs_detection: a seeded sign-flip AttackPlan lowered
+      INTO the fused program (``plan.engine_scales`` →
+      ``run_rounds(attack_scales=...)``) — the ledger's deterministic
+      ``detections()`` view and the quarantine replay scored against
+      the plan's ground truth (acceptance: precision = recall = 1.0 and
+      an exact quarantine-set match).
+    - extra.engine_obs_ab: windowed ``run_rounds`` rounds/sec with the
+      carry off vs on (fan-out registry-only — the other planes stay
+      off, as in a production scrape) — the enabled tax must stay
+      within the shared 5% budget. Arms interleave, best-of-3, warm
+      runs discarded (the observability-tier discipline). The A/B
+      round carries a REPRESENTATIVE local-fit load (2000
+      samples/node/round): the carry's cost is per-parameter, not
+      per-sample, so a degenerate 16-sample round would measure the
+      carry against a round that exists nowhere (real CNN rounds are
+      heavier still — the measured tax is an upper bound).
+    """
+    import hashlib
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpfl.attacks.plan import AttackPlan, AttackSpec
+    from tpfl.management import engine_obs, ledger, quarantine
+    from tpfl.models import MLP
+    from tpfl.parallel import FederationEngine
+    from tpfl.settings import Settings
+
+    try:
+        snap = Settings.snapshot()
+        try:
+            Settings.set_test_settings()
+            # Let CI env overrides (TPFL_TELEMETRY_DUMP_DIR — the
+            # flight-dump artifact on failure) back through the
+            # profile reset.
+            Settings.from_env()
+            nE, nbE, bsE = 32, 1, 16
+            hidden = (64,)
+            rngE = np.random.default_rng(7)
+            xsE = rngE.random((nE, nbE, bsE, 28, 28), np.float32)
+            ysE = rngE.integers(0, 10, (nE, nbE, bsE)).astype(np.int32)
+
+            def engine():
+                return FederationEngine(
+                    MLP(hidden_sizes=hidden), nE, mesh=None,
+                    learning_rate=0.1, seed=0,
+                )
+
+            # (a) Program split + byte determinism.
+            def hlo_digest(eng, tele):
+                fn = eng.program(
+                    "plain", 1, 2, 1, donate=False, telemetry=tele
+                )
+                p = eng.init_params((28, 28))
+                xs_d, ys_d = eng.shard_data(xsE, ysE)
+                low = fn.lower(
+                    p, {}, {}, {}, xs_d, ys_d,
+                    eng.pad_weights(None), eng.valid,
+                )
+                return hashlib.sha256(low.as_text().encode()).hexdigest()
+
+            e1 = engine()
+            off1 = hlo_digest(e1, False)
+            on_d = hlo_digest(e1, True)
+            off2 = hlo_digest(engine(), False)
+
+            def model_bytes(tele):
+                Settings.ENGINE_TELEMETRY = tele
+                eng = engine()
+                p = eng.init_params((28, 28))
+                xs_d, ys_d = eng.shard_data(xsE, ysE)
+                p, _ = eng.run_rounds(p, xs_d, ys_d, n_rounds=3)
+                return b"".join(
+                    np.asarray(leaf).tobytes()
+                    for leaf in jax.tree_util.tree_leaves(p)
+                )
+
+            extra["engine_obs_program"] = {
+                "off_hlo_identical": bool(off1 == off2),
+                "carry_changes_program": bool(on_d != off1),
+                "model_bytes_identical": bool(
+                    model_bytes(False) == model_bytes(True)
+                ),
+            }
+
+            # (b) Seeded engine-tier sign-flip adversary through the
+            # ledger/quarantine, from the carry alone.
+            Settings.ENGINE_TELEMETRY = True
+            Settings.LEDGER_ENABLED = True
+            ledger.contrib.reset()
+            ledger.convergence.reset()
+            plan = AttackPlan(
+                {3: AttackSpec("sign_flip"), 11: AttackSpec("sign_flip")},
+                seed=7,
+            )
+            addrs = engine_obs.peer_names(nE)
+            scales = plan.engine_scales(addrs, n_rounds=4)
+            engD = engine()
+            pD = engD.init_params((28, 28))
+            xs_d, ys_d = engD.shard_data(xsE, ysE)
+            engD.run_rounds(pD, xs_d, ys_d, n_rounds=4, attack_scales=scales)
+            det = ledger.contrib.detections()
+            truth = set(plan.adversary_map(addrs))
+            flagged = set(det.get("flagged", {}))
+            tp = len(flagged & truth)
+            quarantined = quarantine.quarantined_from_replay(
+                quarantine.replay_decisions(det)
+            )
+            extra["engine_obs_detection"] = {
+                "nodes": nE,
+                "rounds": 4,
+                "adversaries": sorted(truth),
+                "flagged": sorted(flagged),
+                "entries_scored": len(det.get("entries", [])),
+                "precision": round(tp / len(flagged), 4) if flagged else 0.0,
+                "recall": round(tp / len(truth), 4) if truth else 1.0,
+                "quarantine_exact": bool(quarantined == truth),
+            }
+            ledger.contrib.reset()
+            ledger.convergence.reset()
+            Settings.LEDGER_ENABLED = False
+
+            # (c) Off/on overhead A/B over windowed run_rounds
+            # (registry-only fan-out — the production-scrape shape).
+            # Both arms consume each window's losses (the
+            # FederationLearner shape: a window's result gates the next
+            # protocol round), so the A/B measures the carry + fan-out
+            # tax, not a pipelining difference.
+            bs_ab, ep_ab, R_ab = 500, 4, 4
+            xsA = rngE.random((nE, nbE, bs_ab, 28, 28), np.float32)
+            ysA = rngE.integers(0, 10, (nE, nbE, bs_ab)).astype(np.int32)
+            # ONE engine per arm, reused across measured runs — a fresh
+            # engine per run would pay the jit compile inside the timed
+            # region (and the telemetry program compiles slower, which
+            # would bill compile time as round overhead).
+            arms = {}
+            for tele in (False, True):
+                eng = engine()
+                arms[tele] = (
+                    eng,
+                    eng.init_params((28, 28)),
+                    *eng.shard_data(xsA, ysA),
+                )
+
+            def window_elapsed(tele: bool) -> float:
+                Settings.ENGINE_TELEMETRY = tele
+                eng, p, xs_d, ys_d = arms[tele]
+                t0 = time.monotonic()
+                for _ in range(2):
+                    p, losses = eng.run_rounds(
+                        p, xs_d, ys_d, n_rounds=R_ab, epochs=ep_ab,
+                        donate=False,
+                    )
+                    jax.block_until_ready(losses)
+                return time.monotonic() - t0
+
+            window_elapsed(False)  # warm: both arms' programs compile
+            window_elapsed(True)
+            off_times, on_times = [], []
+            for _ in range(3):
+                off_times.append(window_elapsed(False))
+                on_times.append(window_elapsed(True))
+            ab_rounds = 2 * R_ab
+            off_rps = ab_rounds / max(min(off_times), 1e-9)
+            on_rps = ab_rounds / max(min(on_times), 1e-9)
+            overhead = 1.0 - on_rps / max(off_rps, 1e-9)
+            extra["engine_obs_ab"] = {
+                "untelemetered": {
+                    "elapsed_s": round(min(off_times), 3),
+                    "rounds_per_s": round(off_rps, 2),
+                },
+                "telemetered": {
+                    "elapsed_s": round(min(on_times), 3),
+                    "rounds_per_s": round(on_rps, 2),
+                },
+                "rounds_per_dispatch": R_ab,
+                "samples_per_node_round": nbE * bs_ab * ep_ab,
+                "overhead_frac": round(overhead, 4),
+                "within_5pct_budget": bool(overhead < 0.05),
+            }
+        finally:
+            Settings.restore(snap)
+            ledger.contrib.reset()
+            ledger.convergence.reset()
+    except Exception as e:
+        extra["engine_obs_error"] = str(e)[:200]
 
 
 def _byzantine_tier(extra: dict) -> None:
@@ -2394,6 +2593,13 @@ def main() -> None:
 
     if "byzantine" in tiers:
         _byzantine_tier(extra)
+
+    # Engine-plane telemetry tier: program split + byte determinism,
+    # in-program sign-flip adversary through ledger/quarantine, carry
+    # off/on overhead A/B (extra.engine_obs_program /
+    # engine_obs_detection / engine_obs_ab).
+    if "engine_obs" in tiers:
+        _engine_obs_tier(extra)
 
     # Async tier: FedBuff-style buffered rounds vs the synchronous
     # barrier under a 10x-skewed trainer fleet, plus the serialized

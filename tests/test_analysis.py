@@ -27,6 +27,7 @@ REPO = pathlib.Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))  # `tools` package import
 
 from tools.tpflcheck import (  # noqa: E402
+    check_events,
     check_guards,
     check_knobs,
     check_layers,
@@ -362,6 +363,69 @@ def test_trace_fixture(tmp_path):
     # The management layer is exempt — it implements the telemetry.
     root3 = _mini_repo(tmp_path / "mgmt", {"tpfl/management/anchor.py": bad})
     assert check_trace(root3) == []
+
+
+EVENTS_BAD = """\
+    from tpfl.management import tracing
+    from tpfl.management.telemetry import flight
+
+
+    def taps(node):
+        tracing.event("undocumented_thing", node)
+        with tracing.maybe_span("send", node):
+            pass
+        flight.record(
+            node,
+            {"kind": "event", "name": "rogue_event", "node": node, "t": 0.0},
+        )
+"""
+
+EVENTS_DOC = """\
+    # Span taxonomy
+
+    | Span | Meaning |
+    |---|---|
+    | `send` | one outbound hop |
+    | `stage:<Name>` | one stage execution |
+    | `undocumented_thing` | now documented |
+    | `rogue_event` | now documented |
+"""
+
+
+def test_events_fixture(tmp_path):
+    """Every statically-visible flight event/span name must appear in
+    docs/observability.md — undocumented names fail, documenting them
+    (or an f-string's `prefix:` family) passes."""
+    doc_ok = {"docs/observability.md": EVENTS_DOC}
+    doc_missing = {
+        "docs/observability.md": "| `send` | one outbound hop |\n"
+    }
+    root = _mini_repo(
+        tmp_path, {"tpfl/taps.py": EVENTS_BAD, **doc_missing}
+    )
+    found = check_events(root)
+    names = {v.key for v in found}
+    assert names == {"events:undocumented_thing", "events:rogue_event"}, [
+        v.render() for v in found
+    ]
+    root2 = _mini_repo(
+        tmp_path / "ok", {"tpfl/taps.py": EVENTS_BAD, **doc_ok}
+    )
+    assert check_events(root2) == []
+    # f-string families: a `stage:<Name>` doc placeholder covers
+    # f"stage:{...}" emission sites.
+    fstring = """\
+        from tpfl.management import tracing
+
+
+        def run(node, stage):
+            with tracing.maybe_span(f"stage:{stage}", node):
+                pass
+    """
+    root3 = _mini_repo(
+        tmp_path / "fam", {"tpfl/taps.py": fstring, **doc_ok}
+    )
+    assert check_events(root3) == []
 
 
 # --- 3. runtime: TracedLock + traced chaos federation --------------------

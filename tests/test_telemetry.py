@@ -41,8 +41,11 @@ from tpfl.settings import Settings  # noqa: E402
 
 from tools.traceview import (  # noqa: E402
     build_timeline,
+    fleet_view,
     hop_path,
     load,
+    load_metric_dumps,
+    render_fleet,
     summarize,
     trace_complete,
 )
@@ -327,6 +330,58 @@ def test_flight_dump_disabled_without_dir():
     assert rec.dump("n-x", "stop") is None  # no dir -> no file, no error
 
 
+# --- fleet-merged metrics (MetricsRegistry.merge / traceview --fleet) ----
+
+
+def test_registry_merge_sums_and_labels():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.counter("t_m_total", 3, labels={"node": "x"})
+    b.counter("t_m_total", 4, labels={"node": "x"})
+    a.gauge("t_m_gauge", 1.0)
+    b.gauge("t_m_gauge", 2.0)
+    a.observe("t_m_hist", 0.01)
+    b.observe("t_m_hist", 0.02)
+
+    # Unlabeled merge: counters sum, gauges later-wins, histograms sum.
+    merged = MetricsRegistry.merge(a, b)
+    folded = merged.fold()
+    assert folded["counters"][("t_m_total", (("node", "x"),))] == 7.0
+    assert folded["gauges"][("t_m_gauge", ())] == 2.0
+    hist = folded["histograms"][("t_m_hist", ())]
+    assert hist[-1] == 2  # observation count
+
+    # Named merge: every series gains origin=<name> — the fleet view.
+    fleet = MetricsRegistry.merge(a, b, names=["n0", "n1"])
+    folded = fleet.fold()
+    assert folded["counters"][
+        ("t_m_total", (("node", "x"), ("origin", "n0")))
+    ] == 3.0
+    assert folded["counters"][
+        ("t_m_total", (("node", "x"), ("origin", "n1")))
+    ] == 4.0
+    assert 'origin="n0"' in fleet.render_prometheus()
+    with pytest.raises(ValueError, match="names"):
+        MetricsRegistry.merge(a, b, names=["only-one"])
+
+
+def test_traceview_fleet_view(tmp_path):
+    for name, val in (("alpha", 1.0), ("beta", 2.0)):
+        reg = MetricsRegistry()
+        reg.counter("t_f_total", val, labels={"node": name})
+        reg.gauge("t_f_gauge", val)
+        (tmp_path / f"metrics-{name}.json").write_text(reg.dump_json())
+    docs = load_metric_dumps([str(tmp_path)])
+    assert sorted(docs) == ["alpha", "beta"]
+    view = fleet_view(docs)
+    assert view["nodes"] == ["alpha", "beta"]
+    assert view["counters"]["t_f_total{node=alpha,origin=alpha}"] == 1.0
+    assert view["counters"]["t_f_total{node=beta,origin=beta}"] == 2.0
+    assert view["gauges"]["t_f_gauge{origin=alpha}"] == 1.0
+    text = render_fleet(view)
+    assert "t_f_total{node=beta,origin=beta} 2" in text
+    assert text.startswith("# fleet view: 2 nodes")
+
+
 # --- prometheus HTTP endpoint ---------------------------------------------
 
 
@@ -352,6 +407,92 @@ def test_metrics_http_server_scrape():
         assert doc["counters"]["t_scrape_total{node=s}"] == 7.0
     finally:
         srv.stop()
+
+
+def test_metrics_http_server_concurrent_scrape_live_federation():
+    """Threaded scrape loop against the process registry while a live
+    2-node federation mutates it: every response is a 200 with
+    parseable, internally-consistent content — no torn reads, no 500s
+    (the fold path snapshots mutating shards via bounded retry)."""
+    import urllib.request
+
+    from tpfl.communication.memory import clear_registry
+    from tpfl.learning.dataset import (
+        RandomIIDPartitionStrategy,
+        synthetic_mnist,
+    )
+    from tpfl.management.logger import logger
+    from tpfl.management.web_services import MetricsHTTPServer
+    from tpfl.models import create_model
+    from tpfl.node import Node
+    from tpfl.utils import wait_convergence, wait_to_finish
+
+    clear_registry()
+    Settings.SEED = 99
+    Settings.ELECTION = "hash"
+    Settings.LOG_LEVEL = "ERROR"
+    logger.set_level("ERROR")
+
+    srv = MetricsHTTPServer()  # the process-wide registry
+    port = srv.start()
+    failures: list[str] = []
+    scraped: list[int] = []
+    stop = threading.Event()
+
+    def scrape_loop(path: str) -> None:
+        while not stop.is_set():
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=5
+                ) as resp:
+                    body = resp.read()
+                    if resp.status != 200:
+                        failures.append(f"{path}: HTTP {resp.status}")
+                        continue
+                if path == "/metrics.json":
+                    json.loads(body)
+                elif b"# TYPE" not in body:
+                    failures.append(f"{path}: no TYPE lines")
+                scraped.append(1)
+            except Exception as e:  # torn read / refused / 500
+                failures.append(f"{path}: {type(e).__name__}: {e}")
+
+    scrapers = [
+        threading.Thread(
+            target=scrape_loop, args=(p,), name=f"t-scrape-{i}", daemon=True
+        )
+        for i, p in enumerate(("/metrics", "/metrics.json", "/metrics"))
+    ]
+    ds = synthetic_mnist(n_train=160, n_test=40, seed=0, noise=0.8)
+    parts = ds.generate_partitions(2, RandomIIDPartitionStrategy, seed=1)
+    nodes = [
+        Node(
+            create_model("mlp", (28, 28), seed=7, hidden_sizes=(16,)),
+            parts[i],
+            addr=f"t-scrape-fed-{i}",
+            learning_rate=0.05,
+            batch_size=32,
+        )
+        for i in range(2)
+    ]
+    for t in scrapers:
+        t.start()
+    for nd in nodes:
+        nd.start()
+    try:
+        nodes[0].connect(nodes[1].addr)
+        wait_convergence(nodes, 1, only_direct=False, wait=10)
+        nodes[0].set_start_learning(rounds=2, epochs=1)
+        wait_to_finish(nodes, timeout=240)
+    finally:
+        for nd in nodes:
+            nd.stop()
+        stop.set()
+        for t in scrapers:
+            t.join(timeout=5)
+        srv.stop()
+    assert not failures, failures[:10]
+    assert len(scraped) > 10  # the loop genuinely scraped mid-round
 
 
 # --- e2e: traced chaos federation (acceptance criterion) ------------------

@@ -12,6 +12,9 @@ framework, shared file-walking / waiver / reporting machinery
     trace     timing/logging-path lint (no time.time() or raw logging
               outside tpfl/management — spans/metrics are the only
               sanctioned timing path; see docs/observability.md)
+    events    event-name drift lint (every flight span/event name
+              emitted in tpfl/ must appear in docs/observability.md's
+              taxonomy tables — waivable)
     wire      codec-registry, copy-discipline and RPC-path lints
               (the original wirecheck trio)
 
@@ -34,6 +37,7 @@ from tools.tpflcheck.core import (
     load_waivers,
     repo_root,
 )
+from tools.tpflcheck.events import check_events
 from tools.tpflcheck.guards import check_guards
 from tools.tpflcheck.knobs import check_knobs
 from tools.tpflcheck.layers import check_layers
@@ -44,6 +48,7 @@ from tools.tpflcheck.trace import check_trace
 __all__ = [
     "Violation",
     "Waivers",
+    "check_events",
     "check_guards",
     "check_knobs",
     "check_layers",
@@ -70,6 +75,7 @@ def run_all(
     violations += knob_violations
     violations += check_threads(root)
     violations += check_trace(root)
+    violations += check_events(root)
     violations += wire.violations(root)
 
     waivers = load_waivers(root)

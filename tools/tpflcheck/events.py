@@ -1,0 +1,123 @@
+"""Event-name drift lint: every flight-recorder span/event name emitted
+anywhere in ``tpfl/`` must appear in ``docs/observability.md``.
+
+The flight rings are the post-mortem surface — ``traceview`` timelines,
+crash dumps, the ledger/quarantine joins — and their event taxonomy is
+DOCUMENTED DATA (the span/event tables in docs/observability.md). A new
+emission site that never lands in the doc rots the taxonomy silently:
+the dump contains names no table explains. This lint closes the loop:
+
+- **emitted** names are collected by AST walk over ``tpfl/``:
+  ``flight.record(node, {... "name": "<literal>" ...})`` dict literals,
+  and ``tracing.maybe_span("<literal>", ...)`` /
+  ``tracing.event("<literal>", ...)`` call sites. Non-literal names
+  (``"name": action`` variables, f-strings past their constant prefix)
+  cannot be linted statically and are skipped — except f-strings with a
+  constant ``prefix:`` head (``f"stage:{...}"``), which match a
+  documented ``prefix:`` token.
+- **documented** names are every backticked token in
+  ``docs/observability.md`` (tables and prose both count — the doc's
+  convention is that every taxonomy name renders as code).
+
+Waivable like every check (``events:<name>`` keys) — for names that are
+deliberately internal — so the taxonomy can evolve without the lint
+blocking, but never silently.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+
+from tools.tpflcheck.core import Violation, py_files, rel, repo_root
+
+DOC = "docs/observability.md"
+
+_BACKTICK_RE = re.compile(r"`([^`]+)`")
+
+
+def _documented_names(root: pathlib.Path) -> set[str]:
+    doc = root / DOC
+    if not doc.exists():
+        return set()
+    # Per-line matching: an unbalanced backtick anywhere must not flip
+    # every subsequent code-span pairing in the file.
+    names: set[str] = set()
+    for line in doc.read_text(encoding="utf-8").splitlines():
+        names.update(_BACKTICK_RE.findall(line))
+    return names
+
+
+def _constant_prefix(node: ast.JoinedStr) -> "str | None":
+    """The leading constant of an f-string when it names a taxonomy
+    family (``f"stage:{...}"`` -> ``"stage:"``), else None."""
+    if node.values and isinstance(node.values[0], ast.Constant):
+        head = str(node.values[0].value)
+        if ":" in head:
+            return head.split(":", 1)[0] + ":"
+    return None
+
+
+def _emitted_names(
+    root: pathlib.Path,
+) -> "list[tuple[str, str, int]]":
+    """[(name-or-prefix, file, line)] for every statically-visible
+    span/event emission in tpfl/."""
+    out: list[tuple[str, str, int]] = []
+    for path in py_files(root):
+        r = rel(root, path)
+        tree = ast.parse(path.read_text(encoding="utf-8"))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not isinstance(fn, ast.Attribute):
+                continue
+            name_node = None
+            if (
+                fn.attr == "record"
+                and len(node.args) >= 2
+                and isinstance(node.args[1], ast.Dict)
+            ):
+                for k, v in zip(node.args[1].keys, node.args[1].values):
+                    if isinstance(k, ast.Constant) and k.value == "name":
+                        name_node = v
+            elif (
+                fn.attr in ("maybe_span", "event")
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "tracing"
+                and node.args
+            ):
+                name_node = node.args[0]
+            if name_node is None:
+                continue
+            if isinstance(name_node, ast.Constant):
+                out.append((str(name_node.value), r, name_node.lineno))
+            elif isinstance(name_node, ast.JoinedStr):
+                prefix = _constant_prefix(name_node)
+                if prefix is not None:
+                    out.append((prefix, r, name_node.lineno))
+    return out
+
+
+def check_events(repo: "pathlib.Path | None" = None) -> list[Violation]:
+    root = repo_root(repo)
+    documented = _documented_names(root)
+    # A documented `stage:<Name>` placeholder covers the `stage:`
+    # prefix family; plain names match exactly.
+    doc_prefixes = {d.split("<", 1)[0] for d in documented if "<" in d}
+    out: list[Violation] = []
+    for name, file, line in _emitted_names(root):
+        if name in documented or name in doc_prefixes:
+            continue
+        out.append(
+            Violation(
+                "events", file, line,
+                f"flight event/span name {name!r} is not documented in "
+                f"{DOC} — add it to the span/event tables (or waive "
+                "with a reason)",
+                f"events:{name}",
+            )
+        )
+    return out

@@ -44,6 +44,7 @@ ALLOWED_PREFIX = "tpfl/management/"
 LINTED_MANAGEMENT = (
     "tpfl/management/ledger.py",
     "tpfl/management/quarantine.py",
+    "tpfl/management/engine_obs.py",
 )
 
 _LOGGING_CALLS = {

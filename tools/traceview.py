@@ -22,6 +22,12 @@ Run::
     python -m tools.traceview logs/flight-*.json
     python -m tools.traceview --summary dumps/
 
+``--fleet`` switches input to per-node ``MetricsRegistry.dump_json``
+documents (``metrics-<node>.json``) and renders ONE labeled-by-node
+Prometheus/JSON view of the whole simulation's registries
+(:func:`fleet_view` / :func:`render_fleet`; the in-process equivalent
+is ``MetricsRegistry.merge``) — today each node scrapes in isolation.
+
 ``--ledger`` joins the learning-plane ledger's ``contrib`` / ``anomaly``
 events (``tpfl.management.ledger``, recorded into the same flight rings
 when ``Settings.LEDGER_ENABLED``) with the hop timelines by trace id:
@@ -245,6 +251,76 @@ def render_ledger(timeline: dict[str, list[dict]]) -> str:
     return "\n".join(lines)
 
 
+def load_metric_dumps(paths: Iterable[str]) -> dict[str, dict]:
+    """Load per-node ``MetricsRegistry.dump_json`` documents for the
+    fleet view: files (or directories of ``metrics-*.json``) keyed by
+    node name — the ``metrics-`` / ``.json`` trimmed file stem."""
+    docs: dict[str, dict] = {}
+    files: list[pathlib.Path] = []
+    for p in paths:
+        path = pathlib.Path(p)
+        if path.is_dir():
+            files.extend(sorted(path.glob("metrics-*.json")))
+        else:
+            files.append(path)
+    for path in files:
+        name = path.stem
+        if name.startswith("metrics-"):
+            name = name[len("metrics-"):]
+        docs[name] = json.loads(path.read_text(encoding="utf-8"))
+    return docs
+
+
+def _with_origin(series: str, origin: str) -> str:
+    if series.endswith("}"):
+        return f"{series[:-1]},origin={origin}}}"
+    return f"{series}{{origin={origin}}}"
+
+
+def fleet_view(docs: dict[str, dict]) -> dict[str, Any]:
+    """Merge per-node metrics dumps into ONE labeled-by-node view —
+    today each node's registry scrapes in isolation; this is the whole
+    simulation on one axis. Every series gains an ``origin=<node>``
+    label (the in-process equivalent is
+    ``MetricsRegistry.merge(*regs, names=...)``); series strings keep
+    the ``name{k=v,...}`` JSON-dump format."""
+    out: dict[str, Any] = {
+        "nodes": sorted(docs),
+        "counters": {},
+        "gauges": {},
+        "histograms": {},
+    }
+    for name in sorted(docs):
+        doc = docs[name]
+        for kind in ("counters", "gauges"):
+            for series, v in sorted((doc.get(kind) or {}).items()):
+                out[kind][_with_origin(series, name)] = v
+        for series, h in sorted((doc.get("histograms") or {}).items()):
+            out["histograms"][_with_origin(series, name)] = h
+    return out
+
+
+def render_fleet(view: dict[str, Any]) -> str:
+    """Prometheus-flavored text of a :func:`fleet_view` (histograms
+    condense to their ``_sum`` / ``_count`` series — the merged view is
+    for reading across nodes, not for re-scraping)."""
+    lines = [
+        f"# fleet view: {len(view['nodes'])} nodes: "
+        f"{', '.join(view['nodes'])}"
+    ]
+    for series in sorted(view["counters"]):
+        lines.append(f"{series} {view['counters'][series]:g}")
+    for series in sorted(view["gauges"]):
+        lines.append(f"{series} {view['gauges'][series]:g}")
+    for series in sorted(view["histograms"]):
+        h = view["histograms"][series]
+        name, _, labels = series.partition("{")
+        labels = "{" + labels if labels else ""
+        lines.append(f"{name}_sum{labels} {h.get('sum', 0):g}")
+        lines.append(f"{name}_count{labels} {h.get('count', 0)}")
+    return "\n".join(lines) + "\n"
+
+
 def summarize(timeline: dict[str, list[dict]]) -> dict[str, Any]:
     traced = {t: c for t, c in timeline.items() if t}
     complete = {t: c for t, c in traced.items() if trace_complete(c)}
@@ -306,10 +382,23 @@ def main(argv: "list[str] | None" = None) -> int:
         "joined with each payload's hop chain by trace id",
     )
     ap.add_argument(
+        "--fleet", action="store_true",
+        help="fleet metrics view: merge per-node MetricsRegistry JSON "
+        "dumps (metrics-<node>.json) into one labeled-by-node "
+        "Prometheus text (--summary: the merged JSON document)",
+    )
+    ap.add_argument(
         "--limit", type=int, default=20,
         help="max traces to render (0 = all)",
     )
     args = ap.parse_args(argv)
+    if args.fleet:
+        view = fleet_view(load_metric_dumps(args.paths))
+        if args.summary:
+            print(json.dumps(view, indent=2, sort_keys=True))
+        else:
+            print(render_fleet(view), end="")
+        return 0
     timeline = build_timeline(load(args.paths))
     if args.ledger:
         print(render_ledger(timeline))

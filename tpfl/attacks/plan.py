@@ -47,7 +47,7 @@ from __future__ import annotations
 
 import zlib
 from dataclasses import dataclass
-from typing import Any, Iterable, Optional
+from typing import Any, Iterable, Optional, Sequence
 
 from tpfl.attacks.attacks import AdversarialLearner
 from tpfl.settings import Settings
@@ -194,6 +194,38 @@ class AttackPlan:
             noise = jax.random.normal(k, jnp.shape(leaf), jnp.float32)
             out.append(leaf + (std * noise).astype(jnp.asarray(leaf).dtype))
         return jax.tree_util.tree_unflatten(treedef, out)
+
+    def engine_scales(
+        self,
+        addrs: "Sequence[str]",
+        n_rounds: int,
+        start_round: int = 0,
+    ) -> Any:
+        """Lower this plan's sign-flip schedule into the fused round
+        program: a ``[n_rounds, n]`` per-node multiplier array for
+        :meth:`tpfl.parallel.engine.FederationEngine.run_rounds`'s
+        ``attack_scales`` — ``scale = 1 − 2α`` at each round's
+        ``strength()``, exactly :meth:`poison`'s sign-flip lowering, so
+        the engine tier's seeded adversary is the same adversary the
+        gRPC tier's ``PlannedAdversary`` applies after a fit. Only
+        sign-flip specs lower to a multiplicative scale; other attack
+        families (additive noise, replay modes) have no in-program
+        equivalent here and raise."""
+        import numpy as np
+
+        out = np.ones((int(n_rounds), len(addrs)), np.float32)
+        for i, addr in enumerate(addrs):
+            spec = self.spec_for(addr, i)
+            if spec is None:
+                continue
+            if spec.attack != "sign_flip":
+                raise ValueError(
+                    "engine_scales lowers sign_flip schedules only, "
+                    f"got {spec.attack!r} for {addr!r}"
+                )
+            for r in range(int(n_rounds)):
+                out[r, i] = 1.0 - 2.0 * spec.strength(start_round + r)
+        return out
 
     def adversary_map(
         self, addrs: "Iterable[str] | None" = None

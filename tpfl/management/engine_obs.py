@@ -1,0 +1,201 @@
+"""Engine plane: fan the fused round program's telemetry carry out
+into the three observatory planes.
+
+The pod-scale :class:`~tpfl.parallel.engine.FederationEngine` compiles
+K federation rounds into ONE XLA dispatch — which made those rounds
+invisible to every observatory built so far: a 16-round window emitted
+one profiler span, zero ledger entries and zero convergence events, so
+quarantine and divergence detection simply did not exist on the engine
+tier. ``Settings.ENGINE_TELEMETRY`` closes that hole from the inside
+(the Podracer/Anakin discipline: carry the telemetry THROUGH the device
+loop): the engine threads a fixed-shape ``[n_rounds, ...]`` buffer
+through its ``fori_loop`` carry — per round and per node, train loss,
+update L2 norm and cosine vs the round-start reference; per round,
+global-model delta norm, model norm, participation count and fold
+weight mass — all computed from values the program already holds.
+
+This module is the HOST half: :func:`replay_window` takes the window's
+carry (numpy, one sync per window) and replays it into the existing
+planes, honoring exactly the knobs the gRPC tier honors:
+
+- ``tpfl_engine_*`` registry series — ALWAYS (the PR-5 rule: the carry
+  already paid the compute; registry updates are cheap dict writes);
+- per-round :class:`~tpfl.management.profiling.RoundProfiler`
+  attribution rows under the ``engine:<model>`` node — the window's
+  measured dispatch/train split divided over its device-side rounds
+  (``PROFILING_ENABLED``);
+- :class:`~tpfl.management.ledger.ConvergenceMonitor`
+  divergence/plateau events from the per-round delta norms
+  (``LEDGER_ENABLED``);
+- :class:`~tpfl.management.ledger.ContributionLedger` entries — each
+  elected node's (update norm, reference cosine) scored by the same
+  :class:`~tpfl.management.ledger.AnomalyScorer` thresholds as the
+  protocol tier, so ``detections()`` and the quarantine replay judge
+  engine-tier adversaries identically (``LEDGER_ENABLED`` or
+  ``QUARANTINE_ENABLED`` — ``ledger.active()``).
+
+Determinism (the BlazeFL constraint): the carry is read-only over the
+round program — enabling it cannot perturb the model bytes — and every
+fan-out verdict is a pure function of the (seed-deterministic) carry
+values, so same-seed windows replay byte-identical flags.
+
+Concurrency: this module holds no state of its own; every sink it
+writes to (registry shards, profiler, ledger, flight rings) takes its
+own lock. jax is never imported — the fan-out sees host numpy buffers
+only and adds ZERO device dispatches.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional, Sequence
+
+from tpfl.management.ledger import (
+    COSINE_BUCKETS,
+    NORM_BUCKETS,
+    contrib,
+    convergence,
+)
+from tpfl.management.profiling import rounds
+from tpfl.management.telemetry import flight, metrics
+from tpfl.settings import Settings
+
+
+def enabled() -> bool:
+    return bool(Settings.ENGINE_TELEMETRY)
+
+
+def peer_names(n: int) -> list[str]:
+    """Default engine-tier peer addresses: the engine's nodes are
+    positional (no gRPC addresses), so ledger entries and AttackPlan
+    ground truth key on these synthetic names."""
+    return [f"engine-node-{i}" for i in range(n)]
+
+
+def replay_window(
+    node: str,
+    model: str,
+    start_round: int,
+    telemetry: dict,
+    n_nodes: int,
+    weights: Optional[Any] = None,
+    peers: Optional[Sequence[str]] = None,
+    wall_seconds: float = 0.0,
+    dispatch_seconds: float = 0.0,
+) -> dict:
+    """Replay one window's telemetry carry into the observatory planes.
+
+    ``telemetry``: the engine's carry as host numpy arrays
+    (:data:`tpfl.parallel.engine.TELEMETRY_FIELDS` — per-node buffers
+    ``[R, padded_nodes]``, per-round scalars ``[R]``; pad columns are
+    sliced off here). ``weights``: the window's PADDED fold weights
+    ([padded] or [R, padded]); only elected (weight > 0) nodes become
+    ledger entries — matching the gRPC tier, where only contributors
+    reach an aggregator's intake. Returns a summary
+    ``{"rounds", "recorded", "flagged", "events"}``.
+    """
+    import numpy as np
+
+    loss = np.asarray(telemetry["loss"], np.float64)[:, :n_nodes]
+    upd = np.asarray(telemetry["update_norm"], np.float64)[:, :n_nodes]
+    cos = np.asarray(telemetry["cos_ref"], np.float64)[:, :n_nodes]
+    delta = np.asarray(telemetry["delta_norm"], np.float64)
+    mnorm = np.asarray(telemetry["model_norm"], np.float64)
+    part = np.asarray(telemetry["participation"], np.float64)
+    wmass = np.asarray(telemetry["weight_mass"], np.float64)
+    n_rounds = int(loss.shape[0])
+    names = list(peers) if peers is not None else peer_names(n_nodes)
+    w = None if weights is None else np.asarray(weights, np.float64)
+
+    ledger_on = bool(
+        Settings.LEDGER_ENABLED or Settings.QUARANTINE_ENABLED
+    )
+    labels = {"model": model}
+    recorded = flagged = 0
+    events: list[dict] = []
+    per_round_wall = max(wall_seconds, 1e-9) / max(n_rounds, 1)
+    per_round_dispatch = max(dispatch_seconds, 0.0) / max(n_rounds, 1)
+    per_round_train = max(
+        0.0, (wall_seconds - dispatch_seconds) / max(n_rounds, 1)
+    )
+    for r in range(n_rounds):
+        rnd = start_round + r
+        if w is None:
+            elected = np.ones((n_nodes,), bool)
+            w_r = np.ones((n_nodes,), np.float64)
+        else:
+            w_r = (w if w.ndim == 1 else w[r])[:n_nodes]
+            elected = w_r > 0
+            if not elected.any():
+                # All-zero round weights fall back to a uniform fold
+                # over real nodes (the engine's masked-mean fallback):
+                # everyone contributed.
+                elected = np.ones((n_nodes,), bool)
+                w_r = np.ones((n_nodes,), np.float64)
+        metrics.counter("tpfl_engine_rounds_total", labels=labels)
+        for i in np.flatnonzero(elected):
+            metrics.observe(
+                "tpfl_engine_update_norm", float(upd[r, i]),
+                labels=labels, buckets=NORM_BUCKETS,
+            )
+            metrics.observe(
+                "tpfl_engine_cos_ref", float(cos[r, i]),
+                labels=labels, buckets=COSINE_BUCKETS,
+            )
+        rounds.record_external(
+            node, rnd,
+            {"dispatch": per_round_dispatch, "train": per_round_train},
+            per_round_wall,
+        )
+        out = convergence.observe_delta(
+            node, rnd, float(delta[r]), float(mnorm[r])
+        )
+        if out is not None and out.get("event"):
+            events.append(out)
+        if ledger_on:
+            for i in np.flatnonzero(elected):
+                entry = contrib.record_external(
+                    node, names[i], rnd,
+                    float(upd[r, i]), float(cos[r, i]),
+                    num_samples=max(1, int(round(float(w_r[i])))),
+                )
+                if entry is not None:
+                    recorded += 1
+                    if entry["flagged"]:
+                        flagged += 1
+    last = n_rounds - 1
+    metrics.gauge(
+        "tpfl_engine_loss", float(np.mean(loss[last])), labels=labels
+    )
+    metrics.gauge("tpfl_engine_delta_norm", float(delta[last]), labels=labels)
+    metrics.gauge("tpfl_engine_model_norm", float(mnorm[last]), labels=labels)
+    metrics.gauge(
+        "tpfl_engine_participation", float(part[last]), labels=labels
+    )
+    metrics.gauge("tpfl_engine_weight_mass", float(wmass[last]), labels=labels)
+    if flagged:
+        metrics.counter(
+            "tpfl_engine_flagged_total", float(flagged), labels=labels
+        )
+    flight.record(
+        node,
+        {
+            "kind": "event",
+            "name": "engine_window",
+            "node": node,
+            "trace": "",
+            "t": time.monotonic(),
+            "model": model,
+            "start_round": int(start_round),
+            "rounds": n_rounds,
+            "loss": round(float(np.mean(loss[last])), 6),
+            "delta_norm": round(float(delta[last]), 6),
+            "flagged": flagged,
+        },
+    )
+    return {
+        "rounds": n_rounds,
+        "recorded": recorded,
+        "flagged": flagged,
+        "events": events,
+    }

@@ -581,6 +581,92 @@ class ContributionLedger:
         self._emit(entry)  # OUTSIDE _lock
         return entry
 
+    def record_external(
+        self,
+        node: str,
+        peer: str,
+        round: "int | None",
+        update_norm: float,
+        cos_ref: float,
+        num_samples: int = 1,
+        trace: str = "",
+        staleness: int = 0,
+    ) -> "dict | None":
+        """Score-and-record one contribution whose statistics were
+        already computed elsewhere — the engine plane's fan-out
+        (``tpfl.management.engine_obs``): the fused round program's
+        telemetry carry holds each node's update norm and reference
+        cosine, so the entry needs NO open round, no pinned reference
+        params and zero device work here. Scored against this observer
+        ring's prior clean window through the same
+        :class:`AnomalyScorer` thresholds as the gRPC-tier intake, and
+        emitted identically (``tpfl_contrib_*`` metrics, ``contrib`` /
+        ``anomaly`` flight events) — so :meth:`detections` and
+        ``tpfl.management.quarantine.replay_decisions`` judge
+        engine-tier contributions exactly like protocol-tier ones.
+        Deduped by (peer, round) per observer: a replayed window
+        returns the existing entry."""
+        if not active():
+            return None
+        rnd = int(round) if round is not None else -1
+        version = rnd - int(staleness)
+        with self._lock:
+            ring = self._rings.get(node)
+            if ring is None:
+                ring = self._rings[node] = deque(
+                    maxlen=max(1, int(Settings.LEDGER_RING))
+                )
+            for e in reversed(ring):
+                if (
+                    e["single"]
+                    and e["peer"] == peer
+                    and e["round"] == rnd
+                    and e["update_norm"] is not None
+                ):
+                    return e
+            vkey = (node, peer)
+            prev_version = self._peer_version.get(vkey)
+            regressed = prev_version is not None and version < prev_version
+            self._peer_version[vkey] = (
+                version if prev_version is None else max(prev_version, version)
+            )
+            window = [
+                x["update_norm"]
+                for x in ring
+                if x["single"]
+                and x["update_norm"] is not None
+                and x.get("version", x["round"]) < version
+                and not x["flagged"]
+            ]
+            flagged, reasons, z_norm = AnomalyScorer.score(
+                float(update_norm), float(cos_ref), window,
+                staleness=staleness, version_regressed=regressed,
+            )
+            entry = {
+                "node": node,
+                "peer": peer,
+                "contributors": [peer],
+                "single": True,
+                "round": rnd,
+                "staleness": int(staleness),
+                "version": version,
+                "num_samples": int(num_samples),
+                "update_norm": float(update_norm),
+                "ref_norm": None,
+                "cos_ref": float(cos_ref),
+                "cos_mean": None,
+                "leaf_norms": [],
+                "trace": trace,
+                "t": time.monotonic(),
+                "z_norm": _round(z_norm, 4),
+                "flagged": flagged,
+                "reasons": list(reasons),
+                "quarantined": False,
+            }
+            ring.append(entry)
+        self._emit(entry)  # OUTSIDE _lock
+        return entry
+
     def flush(self, node: Optional[str] = None) -> None:
         """Materialize pending entries: run each parked contribution's
         fused reduction (in ring order — the donated running-mean
@@ -958,7 +1044,6 @@ class ConvergenceMonitor:
     ) -> "dict | None":
         if not Settings.LEDGER_ENABLED:
             return None
-        rnd = int(round) if round is not None else -1
         with self._lock:
             prev = self._prev.get(node)
             self._prev[node] = params
@@ -969,6 +1054,21 @@ class ConvergenceMonitor:
         except Exception:
             # Structure changed mid-run (model swap): restart the series.
             return None
+        return self.observe_delta(node, round, delta, norm)
+
+    def observe_delta(
+        self, node: str, round: "int | None", delta: float, norm: float
+    ) -> "dict | None":
+        """The plateau/divergence logic over a PRECOMPUTED
+        ``(||x_r − x_{r−1}||, ||x_r||)`` pair — the engine plane's
+        entry point (the fused round program's telemetry carry already
+        holds both, so the fan-out adds no device work);
+        :meth:`observe_global` routes here after its own fused
+        dispatch."""
+        if not Settings.LEDGER_ENABLED:
+            return None
+        rnd = int(round) if round is not None else -1
+        delta, norm = float(delta), float(norm)
         rel = delta / max(norm, _EPS)
         w = self._window()
         with self._lock:

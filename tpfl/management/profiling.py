@@ -485,6 +485,61 @@ class RoundProfiler:
         )
         return record
 
+    def record_external(
+        self, node: str, round: "int | None", parts: dict, wall: float
+    ) -> "dict | None":
+        """Append one COMPLETED round record whose component seconds
+        were measured elsewhere — the engine-plane fan-out's per-round
+        attribution (a device-side window's measured dispatch/train
+        split divided over its rounds, ``tpfl.management.engine_obs``).
+        Emits the same ``tpfl_round_attr_seconds`` histograms and
+        flight ``round`` span as :meth:`end_round`; ``host_other`` is
+        the residual exactly as there. Gated like every profiler tap."""
+        if not Settings.PROFILING_ENABLED:
+            return None
+        wall = max(float(wall), 1e-9)
+        parts = {k: float(v) for k, v in parts.items()}
+        measured = sum(parts.values())
+        parts.setdefault("host_other", max(0.0, wall - measured))
+        record = {
+            "node": node,
+            "round": int(round) if round is not None else -1,
+            "wall": wall,
+            "parts": parts,
+            "coverage": sum(parts.values()) / wall,
+            "measured_frac": measured / wall,
+            # Distinguishes replayed rows (engine fan-out) from rounds
+            # this profiler timed itself.
+            "external": True,
+        }
+        with self._lock:
+            self._done.append(record)
+        for comp, secs in parts.items():
+            metrics.observe(
+                "tpfl_round_attr_seconds", secs,
+                labels={"node": node, "component": comp},
+                buckets=ROUND_BUCKETS,
+            )
+        metrics.observe(
+            "tpfl_round_wall_seconds", wall,
+            labels={"node": node}, buckets=ROUND_BUCKETS,
+        )
+        now = time.monotonic()
+        flight.record(
+            node,
+            {
+                "kind": "span",
+                "name": "round",
+                "node": node,
+                "trace": "",
+                "t0": now - wall,
+                "t1": now,
+                "round": record["round"],
+                **{f"s_{k}": round_(v) for k, v in parts.items()},
+            },
+        )
+        return record
+
     def attribution(self, node: "str | None" = None) -> list[dict]:
         """Completed round records (optionally one node's), oldest
         first — the bench profiling tier / test surface."""

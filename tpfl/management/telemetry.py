@@ -351,6 +351,63 @@ class MetricsRegistry:
             sort_keys=True,
         )
 
+    @classmethod
+    def merge(
+        cls,
+        *registries: "MetricsRegistry",
+        names: "Iterable[str] | None" = None,
+    ) -> "MetricsRegistry":
+        """One registry holding every input registry's folded series —
+        the FLEET view (``tools/traceview.py --fleet`` renders it):
+        counters sum, gauges take the later registry's value, and
+        histograms with matching bucket edges sum elementwise
+        (mismatched edges keep the first registry's series — merging
+        counts across different edges would fabricate observations).
+
+        ``names`` (one per registry) labels every series from registry
+        i with ``origin=<name>``, so per-node registries that never
+        labeled their own series stay distinguishable in the merged
+        Prometheus/JSON view."""
+        name_list = list(names) if names is not None else None
+        if name_list is not None and len(name_list) != len(registries):
+            raise ValueError(
+                f"{len(name_list)} names for {len(registries)} registries"
+            )
+        merged = cls()
+        shard = merged._shard()
+        for i, reg in enumerate(registries):
+            tag = (
+                ()
+                if name_list is None
+                else (("origin", str(name_list[i])),)
+            )
+
+            def key_of(key):
+                name, labels = key
+                if not tag:
+                    return key
+                return (name, tuple(sorted(tuple(labels) + tag)))
+
+            folded = reg.fold()
+            for key, v in folded["counters"].items():
+                k = key_of(key)
+                shard.counters[k] = shard.counters.get(k, 0.0) + v
+            for key, v in folded["gauges"].items():
+                shard.gauges[key_of(key)] = (
+                    next(merged._gauge_seq), float(v),
+                )
+            for key, h in folded["histograms"].items():
+                k = key_of(key)
+                edges = reg._buckets.get(key[0], DEFAULT_BUCKETS)
+                known = merged._buckets.setdefault(key[0], edges)
+                cur = shard.hists.get(k)
+                if cur is None and known == edges:
+                    shard.hists[k] = list(h)
+                elif cur is not None and known == edges and len(cur) == len(h):
+                    for j, c in enumerate(h):
+                        cur[j] += c
+        return merged
+
     def reset(self) -> None:
         """Drop all recorded series (tests / bench A-B runs). Shards
         registered by live threads are emptied, not discarded — the

@@ -24,6 +24,15 @@ program; BlazeFL's bar: the fast path stays seed-deterministic):
   padded with zero-weight clone rows (``tpfl.parallel.mesh`` helpers);
   the masked-mean fold ignores w=0 entries exactly, so padding is
   numerics-free and every chip keeps an equal shard.
+- **In-program telemetry** — ``Settings.ENGINE_TELEMETRY`` threads a
+  fixed-shape ``[n_rounds, ...]`` carry through the window (per round
+  and per node: loss, update norm, reference cosine; per round:
+  global-model delta norm, participation, weight mass — all from
+  values the program already holds) and fans each window out into the
+  observatory planes at close (``tpfl.management.engine_obs``).
+  Disabled, the carry is ELIDED: the program lowers byte-identical to
+  the pre-telemetry path (separate cache slot); enabled, model
+  outputs stay byte-identical — telemetry is read-only.
 
 Determinism discipline: at a FIXED device count, same seed => the same
 byte-identical global model (all reductions have a fixed shape and
@@ -73,6 +82,17 @@ from tpfl.parallel.mesh import (
 from tpfl.settings import Settings
 
 _ALGORITHMS = ("fedavg", "fedprox", "scaffold")
+
+#: The ENGINE_TELEMETRY carry schema (what the telemetry program
+#: variant appends as its sixth output and
+#: ``tpfl.management.engine_obs.replay_window`` consumes): per-round
+#: PER-NODE ``[n_rounds, padded_nodes]`` buffers, then per-round
+#: ``[n_rounds]`` scalars.
+TELEMETRY_NODE_FIELDS = ("loss", "update_norm", "cos_ref")
+TELEMETRY_ROUND_FIELDS = (
+    "delta_norm", "model_norm", "participation", "weight_mass"
+)
+TELEMETRY_FIELDS = TELEMETRY_NODE_FIELDS + TELEMETRY_ROUND_FIELDS
 
 
 # --- auto mesh resolution (Settings.SHARD_* knobs) -----------------------
@@ -192,6 +212,10 @@ class FederationEngine:
         # unguarded: single-owner (see _programs) — dispatch-window
         # ordinal for round-profiler attribution labels.
         self._windows = 0
+        # unguarded: single-owner (see _programs) — cumulative rounds
+        # run through run_rounds: the engine-plane fan-out's round
+        # ordinals stay monotonic across windows.
+        self._rounds_done = 0
         #: [padded_nodes] 1/0 mask of real vs pad rows (the uniform
         #: fallback denominator when a round's weights are all-zero).
         self.valid = valid_node_mask(self.n_nodes, self.padded_nodes)
@@ -266,6 +290,24 @@ class FederationEngine:
         if self.padded_nodes == self.n_nodes:
             return tree
         return jax.tree_util.tree_map(lambda x: x[: self.n_nodes], tree)
+
+    def pad_attack_scales(self, scales: Any) -> Any:
+        """[n] (or per-round [R, n]) per-node attack multipliers ->
+        padded f32 with ONE-valued pad entries (a pad row's params must
+        ride untouched: its fold weight is already zero)."""
+        s = jnp.asarray(scales, jnp.float32)
+        if s.shape[-1] != self.n_nodes:
+            raise ValueError(
+                f"attack_scales last axis is {s.shape[-1]} for "
+                f"{self.n_nodes} nodes"
+            )
+        extra = self.padded_nodes - self.n_nodes
+        if extra == 0:
+            return s
+        pad_shape = s.shape[:-1] + (extra,)
+        return jnp.concatenate(
+            [s, jnp.ones(pad_shape, jnp.float32)], axis=-1
+        )
 
     def shard_data(self, xs: Any, ys: Any) -> tuple[Any, Any]:
         """Pad + place node-stacked batch arrays [n, n_batches, b, ...]
@@ -491,7 +533,8 @@ class FederationEngine:
         return fold
 
     def _build_multi(
-        self, kind: str, epochs: int, n_rounds: int, w_ndim: int
+        self, kind: str, epochs: int, n_rounds: int, w_ndim: int,
+        telemetry: bool = False, a_ndim: int = 0,
     ) -> Callable:
         """The UNJITTED federation program (shard_map-wrapped on a
         mesh): ``fn(params, c_locals, c_global, aux, xs, ys, weights,
@@ -501,52 +544,228 @@ class FederationEngine:
         fori_loop so the dispatch RTT is paid once per window.
         ``VmapFederation``'s builders trace this inside their own jits
         (keeping ``.lower()`` and the legacy donation signatures);
-        :meth:`program` jits it directly."""
+        :meth:`program` jits it directly.
+
+        ``telemetry`` (the ``ENGINE_TELEMETRY`` variant) threads a
+        fixed-shape ``[n_rounds, ...]`` buffer dict through the loop
+        carry — :data:`TELEMETRY_FIELDS`, appended as a SIXTH output —
+        computed from values the round body already holds (the trained
+        params, the round-start params, the fold output, the weights):
+        no extra HBM traffic, and collectives only where the fold
+        already psums. ``telemetry=False`` lowers the byte-identical
+        program of the pre-telemetry path: every telemetry branch below
+        is Python-level, so the carry is elided from the trace, not
+        masked out of it.
+
+        ``a_ndim`` (the adversarial variant, bench/test machinery):
+        appends an ``attack_scales`` argument ([n] or [n_rounds, n])
+        multiplied into each node's TRAINED params before stats and
+        fold — the in-program lowering of ``AttackPlan``'s sign-flip
+        schedule (``scale = 1 − 2α``), so the telemetry carry observes
+        engine-tier adversaries exactly where the gRPC tier's ledger
+        observes protocol-tier ones."""
         local_train = self._build_local_train(kind)
         mesh = self.mesh
         sharded = mesh is not None and mesh_axis_size(mesh) > 1
-        fold = self._build_fold(kind, NODE_AXIS if sharded else None)
+        psum_axis = NODE_AXIS if sharded else None
+        fold = self._build_fold(kind, psum_axis)
+        f32 = jnp.float32
 
-        def round_body(params, c_locals, c_global, aux, xs, ys, w, valid):
+        def per_node_sq(tree):
+            """Σ over leaves/features per node row -> [n_local]."""
+            total = jnp.zeros((), f32)
+            for leaf in jax.tree_util.tree_leaves(tree):
+                total = total + jnp.sum(
+                    leaf.astype(f32).reshape(leaf.shape[0], -1) ** 2, axis=1
+                )
+            return total
+
+        def per_node_dot(a, b):
+            total = jnp.zeros((), f32)
+            for x, y in zip(
+                jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+            ):
+                total = total + jnp.sum(
+                    (x.astype(f32) * y.astype(f32)).reshape(x.shape[0], -1),
+                    axis=1,
+                )
+            return total
+
+        def psum_(x):
+            return lax.psum(x, psum_axis) if psum_axis is not None else x
+
+        def masked_mean(x, valid):
+            num = psum_(jnp.sum(x * valid))
+            den = psum_(jnp.sum(valid))
+            return num / jnp.maximum(den, 1.0)
+
+        def round_body(params, c_locals, c_global, aux, xs, ys, w, valid,
+                       scale):
             trained, new_c, new_aux, losses = jax.vmap(
                 lambda p, ci, a, x, y: local_train(
                     p, ci, c_global, a, x, y, epochs
                 )
             )(params, c_locals, aux, xs, ys)
+            if a_ndim:
+                trained = jax.tree_util.tree_map(
+                    lambda t: (
+                        scale.reshape((-1,) + (1,) * (t.ndim - 1)).astype(
+                            t.dtype
+                        )
+                        * t
+                    ),
+                    trained,
+                )
+            if telemetry:
+                upd = jax.tree_util.tree_map(
+                    lambda t, p: t.astype(f32) - p.astype(f32),
+                    trained, params,
+                )
+                t_sq = per_node_sq(trained)
+                s_sq = per_node_sq(params)
+                node_stats = {
+                    "update_norm": jnp.sqrt(per_node_sq(upd)),
+                    "cos_ref": per_node_dot(trained, params)
+                    / jnp.sqrt(jnp.maximum(t_sq * s_sq, 1e-12)),
+                }
             out_params, out_c, out_cg, out_aux = fold(
                 trained, new_c, new_aux, c_locals, c_global, aux, w, valid
             )
+            if telemetry:
+                # out_params rows are IDENTICAL by construction (the
+                # fold broadcasts the aggregate to every node), so the
+                # global-model stats need one row per device, not the
+                # full [n, P] sweep: row 0 of each local shard,
+                # mean-reduced over devices by the same masked-mean
+                # machinery (all devices hold the same aggregate; their
+                # round-start rows coincide after the first fold).
+                first = valid * (
+                    jnp.arange(valid.shape[0]) == 0
+                ).astype(f32)
+                moved_sq = jnp.zeros((), f32)
+                out_sq = jnp.zeros((), f32)
+                for o, p in zip(
+                    jax.tree_util.tree_leaves(out_params),
+                    jax.tree_util.tree_leaves(params),
+                ):
+                    o0 = o[0].astype(f32)
+                    p0 = p[0].astype(f32)
+                    moved_sq = moved_sq + jnp.sum((o0 - p0) ** 2)
+                    out_sq = out_sq + jnp.sum(o0 * o0)
+                zero = jnp.zeros((valid.shape[0],), f32)
+                round_stats = {
+                    "delta_norm": masked_mean(
+                        zero.at[0].set(jnp.sqrt(moved_sq)), first
+                    ),
+                    "model_norm": masked_mean(
+                        zero.at[0].set(jnp.sqrt(out_sq)), first
+                    ),
+                    "participation": psum_(
+                        jnp.sum((w > 0).astype(f32))
+                    ),
+                    "weight_mass": psum_(jnp.sum(w.astype(f32))),
+                }
+                return (
+                    out_params, out_c, out_cg, out_aux, losses,
+                    (node_stats, round_stats),
+                )
             return out_params, out_c, out_cg, out_aux, losses
 
-        def multi(params, c_locals, c_global, aux, xs, ys, weights, valid):
+        def tele_init(n_local):
+            per_node = jnp.zeros((n_rounds, n_local), f32)
+            per_round = jnp.zeros((n_rounds,), f32)
+            return {
+                "loss": per_node,
+                "update_norm": per_node,
+                "cos_ref": per_node,
+                "delta_norm": per_round,
+                "model_norm": per_round,
+                "participation": per_round,
+                "weight_mass": per_round,
+            }
+
+        def tele_write(tele, r, losses, node_stats, round_stats):
+            tele = dict(tele)
+            tele["loss"] = tele["loss"].at[r].set(losses.astype(f32))
+            for k, v in node_stats.items():
+                tele[k] = tele[k].at[r].set(v)
+            for k, v in round_stats.items():
+                tele[k] = tele[k].at[r].set(v)
+            return tele
+
+        def multi(params, c_locals, c_global, aux, xs, ys, weights, valid,
+                  *extra):
+            scales = extra[0] if a_ndim else None
+
+            def scale_for(r):
+                if not a_ndim:
+                    return None
+                return scales if a_ndim == 1 else scales[r]
+
             if n_rounds == 1:
                 w = weights if w_ndim == 1 else weights[0]
-                return round_body(
-                    params, c_locals, c_global, aux, xs, ys, w, valid
+                out = round_body(
+                    params, c_locals, c_global, aux, xs, ys, w, valid,
+                    scale_for(0),
                 )
+                if telemetry:
+                    p, ci, cg, a, losses, (ns_, rs_) = out
+                    tele = tele_write(
+                        tele_init(valid.shape[0]), 0, losses, ns_, rs_
+                    )
+                    return p, ci, cg, a, losses, tele
+                return out
 
             def body(r, carry):
-                p, ci, cg, a, _ = carry
+                if telemetry:
+                    p, ci, cg, a, _, tele = carry
+                else:
+                    p, ci, cg, a, _ = carry
                 w = weights if w_ndim == 1 else weights[r]
-                return round_body(p, ci, cg, a, xs, ys, w, valid)
+                out = round_body(
+                    p, ci, cg, a, xs, ys, w, valid, scale_for(r)
+                )
+                if telemetry:
+                    p, ci, cg, a, losses, (ns_, rs_) = out
+                    return p, ci, cg, a, losses, tele_write(
+                        tele, r, losses, ns_, rs_
+                    )
+                return out
 
             init_losses = jnp.zeros((valid.shape[0],), jnp.float32)
-            return lax.fori_loop(
-                0, n_rounds, body,
-                (params, c_locals, c_global, aux, init_losses),
-            )
+            init = (params, c_locals, c_global, aux, init_losses)
+            if telemetry:
+                init = init + (tele_init(valid.shape[0]),)
+            return lax.fori_loop(0, n_rounds, body, init)
 
         if not sharded:
             return multi
 
         node = PartitionSpec(NODE_AXIS)
         repl = PartitionSpec()
-        w_spec = node if w_ndim == 1 else PartitionSpec(None, NODE_AXIS)
+        rn = PartitionSpec(None, NODE_AXIS)
+        w_spec = node if w_ndim == 1 else rn
+        in_specs = [node, node, repl, node, node, node, w_spec, node]
+        if a_ndim:
+            in_specs.append(node if a_ndim == 1 else rn)
+        out_specs: tuple = (node, node, repl, node, node)
+        if telemetry:
+            out_specs = out_specs + (
+                {
+                    "loss": rn,
+                    "update_norm": rn,
+                    "cos_ref": rn,
+                    "delta_norm": repl,
+                    "model_norm": repl,
+                    "participation": repl,
+                    "weight_mass": repl,
+                },
+            )
         return shard_map(
             multi,
             mesh=mesh,
-            in_specs=(node, node, repl, node, node, node, w_spec, node),
-            out_specs=(node, node, repl, node, node),
+            in_specs=tuple(in_specs),
+            out_specs=out_specs,
             check_vma=False,
         )
 
@@ -563,36 +782,61 @@ class FederationEngine:
 
     def _build_program(
         self, kind: str, epochs: int, n_rounds: int, w_ndim: int,
-        donate: bool = True,
+        donate: bool = True, telemetry: bool = False, a_ndim: int = 0,
     ) -> Callable:
-        multi = self._build_multi(kind, epochs, n_rounds, w_ndim)
+        multi = self._build_multi(
+            kind, epochs, n_rounds, w_ndim, telemetry, a_ndim
+        )
         dn = (0, 1, 2, 3) if donate else ()
         mesh = self.mesh
         if mesh is None or mesh_axis_size(mesh) <= 1:
             return jax.jit(multi, donate_argnums=dn)
         ns = federation_sharding(mesh)
         rs = replicated(mesh)
-        ws = ns if w_ndim == 1 else NamedSharding(
-            mesh, PartitionSpec(None, NODE_AXIS)
-        )
+        rn = NamedSharding(mesh, PartitionSpec(None, NODE_AXIS))
+        ws = ns if w_ndim == 1 else rn
+        in_sh = [ns, ns, rs, ns, ns, ns, ws, ns]
+        if a_ndim:
+            in_sh.append(ns if a_ndim == 1 else rn)
+        out_sh: tuple = (ns, ns, rs, ns, ns)
+        if telemetry:
+            out_sh = out_sh + (
+                {
+                    "loss": rn,
+                    "update_norm": rn,
+                    "cos_ref": rn,
+                    "delta_norm": rs,
+                    "model_norm": rs,
+                    "participation": rs,
+                    "weight_mass": rs,
+                },
+            )
         return jax.jit(
             multi,
             donate_argnums=dn,
-            in_shardings=(ns, ns, rs, ns, ns, ns, ws, ns),
-            out_shardings=(ns, ns, rs, ns, ns),
+            in_shardings=tuple(in_sh),
+            out_shardings=out_sh,
         )
 
     def program(
         self, kind: str, epochs: int, n_rounds: int = 1, w_ndim: int = 1,
-        donate: bool = True,
+        donate: bool = True, telemetry: bool = False, a_ndim: int = 0,
     ) -> Callable:
         """Cached compiled program for ``(kind, epochs, n_rounds,
         w_ndim)`` — the raw jitted callable (bench drives these from
         inside its own timed loops). ``donate=False`` builds a
         NON-donating variant (separate cache slot): repeated-call
         benchmarking (``best_of_wall``) re-feeds the same input
-        buffers, which a donating program would have consumed."""
-        key = (kind, int(epochs), int(n_rounds), int(w_ndim), bool(donate))
+        buffers, which a donating program would have consumed.
+        ``telemetry``/``a_ndim`` select the ENGINE_TELEMETRY carry /
+        attack-scale variants — separate cache slots, so toggling the
+        knob between windows never mutates an already-compiled
+        program and the disabled program stays the byte-identical
+        pre-telemetry lowering."""
+        key = (
+            kind, int(epochs), int(n_rounds), int(w_ndim), bool(donate),
+            bool(telemetry), int(a_ndim),
+        )
         fn = self._programs.get(key)
         profiling.observatory.cache_event("engine_programs", hit=fn is not None)
         if fn is None:
@@ -601,17 +845,23 @@ class FederationEngine:
 
     def _wrapped_program(
         self, kind: str, epochs: int, n_rounds: int, w_ndim: int,
-        donate: bool = True,
+        donate: bool = True, telemetry: bool = False, a_ndim: int = 0,
     ) -> Callable:
         """The same program behind the compile observatory's recompile
         detection (keyed per (engine program, abstract shapes) like
-        every other jit seam)."""
-        key = (kind, int(epochs), int(n_rounds), int(w_ndim), bool(donate))
+        every other jit seam). Variant programs get their own names —
+        the telemetry/attack signatures differ by construction and must
+        not read as recompile storms of the base program."""
+        key = (
+            kind, int(epochs), int(n_rounds), int(w_ndim), bool(donate),
+            bool(telemetry), int(a_ndim),
+        )
         fn = self._wrapped.get(key)
         if fn is None:
+            suffix = (":obs" if telemetry else "") + (":atk" if a_ndim else "")
             fn = self._wrapped[key] = profiling.observatory.wrap(
                 self.program(*key),
-                f"engine_round:{kind}x{n_rounds}:"
+                f"engine_round:{kind}x{n_rounds}{suffix}:"
                 f"{profiling.module_tag(self.module)}",
             )
         return fn
@@ -647,6 +897,7 @@ class FederationEngine:
         aux: Optional[Any] = None,
         scaffold_state: Optional[tuple[Any, Any]] = None,
         donate: bool = True,
+        attack_scales: Optional[Any] = None,
     ) -> tuple[Any, ...]:
         """Run ``n_rounds`` federation rounds in ONE device dispatch.
 
@@ -656,6 +907,19 @@ class FederationEngine:
         (the bench/simulation semantics; re-stack between windows for
         fresh data). ``donate=False`` keeps the input buffers alive
         (repeated-call benchmarking over the same arrays).
+
+        ``attack_scales`` ([n] or [n_rounds, n], bench/test machinery):
+        per-node multipliers applied to each node's TRAINED params
+        before the fold — the in-program seeded adversary
+        (``AttackPlan.engine_scales``); None (default) compiles no
+        attack machinery at all.
+
+        With ``Settings.ENGINE_TELEMETRY`` the window runs the
+        telemetry-carry program variant and, at window close, fans the
+        device-resident per-round stats out into the observatory planes
+        (:mod:`tpfl.management.engine_obs`); the returned tuple is
+        UNCHANGED — telemetry is read-only over the carry, and the
+        model outputs stay byte-identical to the disabled program's.
 
         Returns (params, losses) — with ``aux`` (possibly ``{}``)
         (params, aux, losses) — and for algorithm="scaffold"
@@ -674,6 +938,14 @@ class FederationEngine:
                 f"per-round weights have {w.shape[0]} rows for "
                 f"{n_rounds} rounds"
             )
+        scales = None
+        if attack_scales is not None:
+            scales = self.pad_attack_scales(attack_scales)
+            if scales.ndim == 2 and scales.shape[0] != n_rounds:
+                raise ValueError(
+                    f"per-round attack_scales have {scales.shape[0]} rows "
+                    f"for {n_rounds} rounds"
+                )
         # Explicit placement, not just padding: callers re-stacking from
         # a single global model (FederationLearner each protocol round)
         # hand in arrays COMMITTED as replicated on the mesh, which the
@@ -697,19 +969,44 @@ class FederationEngine:
                 if w.ndim == 1
                 else NamedSharding(self.mesh, PartitionSpec(None, NODE_AXIS)),
             )
-        fn = self._wrapped_program(kind, epochs, n_rounds, w.ndim, donate)
+            if scales is not None:
+                scales = jax.device_put(
+                    scales,
+                    federation_sharding(self.mesh)
+                    if scales.ndim == 1
+                    else NamedSharding(
+                        self.mesh, PartitionSpec(None, NODE_AXIS)
+                    ),
+                )
+        tele_on = bool(Settings.ENGINE_TELEMETRY)
+        a_ndim = 0 if scales is None else int(scales.ndim)
+        fn = self._wrapped_program(
+            kind, epochs, n_rounds, w.ndim, donate, tele_on, a_ndim
+        )
+        args = [params, c_locals, c_global, a, xs, ys, w, self.valid]
+        if a_ndim:
+            args.append(scales)
 
         prof = profiling.rounds.enabled()
         node_tag = f"engine:{profiling.module_tag(self.module)}"
+        window_start = self._rounds_done
         if prof:
             self._windows += 1
             profiling.rounds.begin_round(node_tag, self._windows)
-        t0 = time.monotonic() if prof else 0.0
-        out_params, out_c, out_cg, out_aux, losses = fn(
-            params, c_locals, c_global, a, xs, ys, w, self.valid
-        )
+        t0 = time.monotonic() if (prof or tele_on) else 0.0
+        try:
+            out = fn(*args)
+        except Exception as e:
+            self._dump_flight(e, kind, n_rounds)
+            raise
+        tele = None
+        if tele_on:
+            out_params, out_c, out_cg, out_aux, losses, tele = out
+        else:
+            out_params, out_c, out_cg, out_aux, losses = out
+        self._rounds_done += n_rounds
+        t1 = time.monotonic() if (prof or tele_on) else 0.0
         if prof:
-            t1 = time.monotonic()
             jax.block_until_ready(losses)
             t2 = time.monotonic()
             # The dispatch gap is paid ONCE for the whole window — the
@@ -717,12 +1014,54 @@ class FederationEngine:
             profiling.rounds.add(node_tag, "dispatch", t1 - t0)
             profiling.rounds.add(node_tag, "train", t2 - t1)
             profiling.rounds.end_round(node_tag, self._windows)
+        if tele is not None:
+            # One host sync per WINDOW: converting the carry blocks on
+            # the program like the profiler's block_until_ready does.
+            from tpfl.management import engine_obs
+
+            host_tele = {k: np.asarray(v) for k, v in tele.items()}
+            engine_obs.replay_window(
+                node_tag,
+                profiling.module_tag(self.module),
+                window_start,
+                host_tele,
+                self.n_nodes,
+                weights=np.asarray(w),
+                wall_seconds=time.monotonic() - t0,
+                dispatch_seconds=t1 - t0,
+            )
 
         if kind == "scaffold":
             return out_params, out_aux, (out_c, out_cg), losses
         if aux is not None:
             return out_params, out_aux, losses
         return out_params, losses
+
+    def _dump_flight(self, exc: Exception, kind: str, n_rounds: int) -> None:
+        """Black-box the failed dispatch: an ``engine_failure`` event
+        in the ``engine`` flight ring, then the ring dumped as
+        ``flight-engine-<reason>.json`` (when TELEMETRY_DUMP_DIR is
+        set) — the same post-mortem discipline as ``Node.stop`` and
+        the chaos harness's crash paths."""
+        try:
+            from tpfl.management.telemetry import flight
+
+            flight.record(
+                "engine",
+                {
+                    "kind": "event",
+                    "name": "engine_failure",
+                    "node": "engine",
+                    "trace": "",
+                    "t": time.monotonic(),
+                    "model": profiling.module_tag(self.module),
+                    "program": f"{kind}x{n_rounds}",
+                    "error": f"{type(exc).__name__}: {exc}"[:200],
+                },
+            )
+            flight.dump("engine", type(exc).__name__.lower())
+        except Exception:
+            pass  # observability must never mask the real failure
 
     # --- evaluation ------------------------------------------------------
 

@@ -630,6 +630,30 @@ class Settings:
     mid-fit) are honored at round granularity; larger windows are
     interruptible only between windows."""
 
+    ENGINE_TELEMETRY: bool = False
+    """Master gate for the engine plane of the observatory
+    (tpfl.management.engine_obs): when on,
+    ``FederationEngine.run_rounds`` compiles the TELEMETRY VARIANT of
+    its round program — a fixed-shape ``[rounds, ...]`` device buffer
+    threaded through the ``fori_loop`` carry that accumulates, per
+    round and per node, train loss, update L2 norm, cosine vs the
+    round-start reference, global-model delta norm, participation
+    count and fold weight mass, all computed from values the program
+    already holds (no extra HBM traffic; ``lax.psum`` only where the
+    fold already psums). At window close one host-side fan-out replays
+    the buffer into the existing planes: per-round ``RoundProfiler``
+    rows (PROFILING_ENABLED), ``ConvergenceMonitor``
+    divergence/plateau events (LEDGER_ENABLED), ``ContributionLedger``
+    entries scored by the same AnomalyScorer/quarantine thresholds as
+    the gRPC tier (LEDGER_ENABLED or QUARANTINE_ENABLED), and
+    always-on ``tpfl_engine_*`` registry series. Off (default): the
+    carry is ELIDED — the engine lowers the byte-identical round
+    program of the pre-telemetry path (separate program-cache slot)
+    and adds zero work. On, same-seed model outputs stay
+    byte-identical at a fixed device count: telemetry is read-only
+    over the carry. Read at program-build time (per run_rounds
+    call). See docs/observability.md "Engine plane"."""
+
     # --- concurrency diagnostics ---
     LOCK_TRACING: bool = False
     """Opt-in runtime lock-order tracing (tpfl.concurrency): every lock
@@ -772,6 +796,11 @@ class Settings:
         cls.SHARD_NODES = False
         cls.SHARD_DEVICES = 0
         cls.SHARD_ROUNDS_PER_DISPATCH = 1
+        # Engine-plane telemetry off by default (engine_obs tests and
+        # the bench engine_obs tier toggle per-case): the elided carry
+        # keeps the engine's round program byte-identical to the
+        # reference path.
+        cls.ENGINE_TELEMETRY = False
 
     @classmethod
     def set_standalone_settings(cls) -> None:
@@ -874,6 +903,10 @@ class Settings:
         cls.SHARD_NODES = False
         cls.SHARD_DEVICES = 0
         cls.SHARD_ROUNDS_PER_DISPATCH = 1
+        # Engine telemetry is an opt-in diagnostic here, like tracing/
+        # profiling: enable it for engine-window runs you intend to
+        # read attribution / convergence / ledger verdicts from.
+        cls.ENGINE_TELEMETRY = False
 
     @classmethod
     def set_scale_settings(cls) -> None:
@@ -1031,6 +1064,12 @@ class Settings:
         cls.SHARD_NODES = True
         cls.SHARD_DEVICES = 0
         cls.SHARD_ROUNDS_PER_DISPATCH = 8
+        # At scale the engine IS the federation — without the carry an
+        # 8-round window is one opaque dispatch none of the planes can
+        # see into — but the fan-out's host work is per-node-per-round,
+        # so like the other observability knobs it stays an explicit
+        # opt-in at this profile's node counts.
+        cls.ENGINE_TELEMETRY = False
 
     @classmethod
     def snapshot(cls) -> dict[str, Any]:
