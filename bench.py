@@ -85,6 +85,18 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
   plus identical per-node controller K/deadline trajectories. The
   stale-flooding defense variant lives in extra.byzantine_async.
 
+- extra.engine_wire_*: device-side wire codec + donation tier
+  (Settings.ENGINE_WIRE_CODEC / ENGINE_DONATE, tpfl/parallel/engine.py
+  + tpfl/learning/compression.py) — engine_wire_program: codec-off
+  HLO-digest stability across a codec toggle (dense lowers the
+  byte-identical pre-codec program), donating-program outputs
+  byte-identical to donate=False, and the compiled-HLO donation
+  inspection clean (every donated state leaf aliases an output
+  buffer); engine_wire_bytes: dense-vs-quant8 per-round exchange
+  bytes from the device-side telemetry carry (gate >= 3x fewer);
+  engine_wire_parity: seeded windowed A/B, quantized steady loss
+  within 2% of dense.
+
 - extra.profiling_*: device-plane observatory tier
   (management/profiling.py) — CompileObservatory recompile detection on
   a shape-churn probe, a seeded 4-node digits A/B with
@@ -827,6 +839,7 @@ TIERS = (
     "primary", "resnet", "attention", "transformer", "sim1000",
     "multichip", "wire", "serde", "chaos", "analysis", "telemetry",
     "profiling", "ledger", "byzantine", "async", "engine_obs",
+    "engine_wire",
 )
 
 
@@ -1393,6 +1406,161 @@ def _engine_obs_tier(extra: dict) -> None:
             ledger.convergence.reset()
     except Exception as e:
         extra["engine_obs_error"] = str(e)[:200]
+
+
+def _engine_wire_tier(extra: dict) -> None:
+    """Device-side wire codec + donation tier (ENGINE_WIRE_CODEC /
+    ENGINE_DONATE over the fused engine). Three reports:
+
+    - extra.engine_wire_program: cache-key/lowering mechanics —
+      ``ENGINE_WIRE_CODEC="dense"`` lowers a STABLE HLO digest across
+      a codec toggle (the codec is elided at trace time, not masked;
+      the program-cache key splits on it), "quant8" lowers a
+      different program, the DONATING program's same-seed outputs are
+      byte-identical to ``donate=False``, and the compiled-HLO
+      donation inspection (``FederationEngine.donation_report``) is
+      CLEAN: every donated state leaf carries a lowering alias marker
+      AND an ``input_output_alias`` pair in the compiled executable —
+      the fused train+fold writes its outputs into the buffers it was
+      handed, no staging copy.
+    - extra.engine_wire_bytes: the bytes/round accounting, read from
+      the DEVICE-side telemetry carry (``wire_bytes`` row =
+      participation x the codec's per-model tensor bytes, same
+      per-leaf policy as the host payload path): dense vs quant8
+      per-round exchange bytes and their ratio — gate >= 3x fewer
+      (f32 models sit at ~3.99x; envelope overhead is a host concept
+      and excluded on both sides).
+    - extra.engine_wire_parity: seeded windowed A/B at the
+      engine_obs-tier scale — the identical federation run dense vs
+      quant8; the quantized steady loss must sit within the 2% gate
+      (int8 symmetric quantization on converging updates is
+      sub-percent in practice).
+    """
+    import jax
+    import numpy as np
+
+    from tpfl.learning import compression
+    from tpfl.management.telemetry import metrics
+    from tpfl.models import MLP
+    from tpfl.parallel import FederationEngine
+    from tpfl.settings import Settings
+
+    try:
+        snap = Settings.snapshot()
+        try:
+            Settings.set_test_settings()
+            Settings.from_env()
+            nW, nbW, bsW = 32, 1, 64
+            rngW = np.random.default_rng(13)
+            xsW = rngW.random((nW, nbW, bsW, 28, 28), np.float32)
+            ysW = rngW.integers(0, 10, (nW, nbW, bsW)).astype(np.int32)
+
+            def engine():
+                return FederationEngine(
+                    MLP(hidden_sizes=(64,)), nW, mesh=None,
+                    learning_rate=0.1, seed=0,
+                )
+
+            # (a) Codec cache-key split + donation mechanics.
+            import hashlib
+
+            def hlo_digest(eng, codec):
+                bits = compression.resolve_engine_codec(codec)
+                fn = eng.program("plain", 1, 2, 1, donate=False, codec=bits)
+                p = eng.init_params((28, 28))
+                xs_d, ys_d = eng.shard_data(xsW, ysW)
+                low = fn.lower(
+                    p, {}, {}, {}, xs_d, ys_d,
+                    eng.pad_weights(None), eng.valid,
+                )
+                return hashlib.sha256(low.as_text().encode()).hexdigest()
+
+            e1 = engine()
+            off1 = hlo_digest(e1, "dense")
+            on_q = hlo_digest(e1, "quant8")
+            e2 = engine()
+            hlo_digest(e2, "quant8")  # codec compiled FIRST
+            off2 = hlo_digest(e2, "dense")
+
+            def model_bytes(donate):
+                Settings.ENGINE_WIRE_CODEC = "dense"
+                eng = engine()
+                p = eng.init_params((28, 28))
+                xs_d, ys_d = eng.shard_data(xsW, ysW)
+                p, _ = eng.run_rounds(p, xs_d, ys_d, n_rounds=3, donate=donate)
+                return b"".join(
+                    np.asarray(leaf).tobytes()
+                    for leaf in jax.tree_util.tree_leaves(p)
+                )
+
+            engD = engine()
+            pD = engD.init_params((28, 28))
+            xs_d, ys_d = engD.shard_data(xsW, ysW)
+            report = engD.donation_report(pD, xs_d, ys_d, n_rounds=2)
+            extra["engine_wire_program"] = {
+                "codec_off_hlo_identical": bool(off1 == off2),
+                "codec_changes_program": bool(on_q != off1),
+                "donate_bytes_identical": bool(
+                    model_bytes(True) == model_bytes(False)
+                ),
+                "donation_clean": bool(report["clean"]),
+                "donation_report": report,
+            }
+
+            # (b) Device-side bytes/round, dense vs quant8, read back
+            # through the telemetry carry -> engine_obs ->
+            # tpfl_engine_wire_bytes gauge (the production scrape path).
+            def wire_bytes(codec):
+                Settings.ENGINE_TELEMETRY = True
+                Settings.ENGINE_WIRE_CODEC = codec
+                eng = engine()
+                p = eng.init_params((28, 28))
+                xs_d, ys_d = eng.shard_data(xsW, ysW)
+                eng.run_rounds(p, xs_d, ys_d, n_rounds=2)
+                folded = metrics.fold()
+                vals = [
+                    v
+                    for k, v in folded["gauges"].items()
+                    if k[0] == "tpfl_engine_wire_bytes"
+                ]
+                return float(vals[-1]) if vals else 0.0
+
+            dense_b = wire_bytes("dense")
+            quant_b = wire_bytes("quant8")
+            Settings.ENGINE_TELEMETRY = False
+            ratio = dense_b / max(quant_b, 1.0)
+            extra["engine_wire_bytes"] = {
+                "dense_bytes_per_round": int(dense_b),
+                "quant8_bytes_per_round": int(quant_b),
+                "ratio": round(ratio, 3),
+                "at_least_3x": bool(ratio >= 3.0),
+            }
+
+            # (c) Loss parity: the same seeded windowed federation,
+            # dense vs quant8 exchange.
+            def steady_loss(codec):
+                Settings.ENGINE_WIRE_CODEC = codec
+                eng = engine()
+                p = eng.init_params((28, 28))
+                xs_d, ys_d = eng.shard_data(xsW, ysW)
+                p, losses = eng.run_rounds(
+                    p, xs_d, ys_d, n_rounds=6, epochs=2
+                )
+                return float(np.mean(np.asarray(losses)))
+
+            loss_d = steady_loss("dense")
+            loss_q = steady_loss("quant8")
+            rel = abs(loss_q - loss_d) / max(abs(loss_d), 1e-9)
+            extra["engine_wire_parity"] = {
+                "dense_loss": round(loss_d, 5),
+                "quant8_loss": round(loss_q, 5),
+                "rel_delta": round(rel, 5),
+                "within_2pct": bool(rel <= 0.02),
+            }
+        finally:
+            Settings.restore(snap)
+    except Exception as e:
+        extra["engine_wire_error"] = str(e)[:200]
 
 
 def _byzantine_tier(extra: dict) -> None:
@@ -2058,23 +2226,28 @@ def main() -> None:
         # misattributes it. Since PR 9 the multi-round window is
         # FRAMEWORK API (`FederationEngine.run_rounds` — the same
         # program `FederationLearner` dispatches per
-        # SHARD_ROUNDS_PER_DISPATCH window); the tier now drives that
-        # seam instead of a bench-local fori_loop, so the measured
-        # number IS the framework path, engine overhead included
-        # (docs/perf_cnn.md round 7). donate=False: best_of_wall
-        # re-feeds the same input buffers.
+        # SHARD_ROUNDS_PER_DISPATCH window); the tier drives that seam
+        # instead of a bench-local fori_loop, so the measured number IS
+        # the framework path, engine overhead included (docs/perf_cnn.md
+        # round 7). Since round 13 the tier times the DONATING program
+        # — the real production variant, state buffers aliased in place
+        # — via best_of_wall_donated: each iteration threads the
+        # window's own output params back in as the next donated input
+        # (the FederationLearner shape), instead of building a
+        # donate=False program just to be timeable.
         w_ones = jnp.ones((n_nodes,), jnp.float32)
         R_INNER = 20
 
         def run_window(p, xs, ys, w):
             return fed.run_rounds(
                 p, xs, ys, weights=w, epochs=epochs, n_rounds=R_INNER,
-                donate=False,
+                donate=True,
             )
 
         with profiling.maybe_trace(args.profile):
-            total, (params, losses) = profiling.best_of_wall(
-                run_window, (params, xs, ys, w_ones)
+            total, (params, losses) = profiling.best_of_wall_donated(
+                run_window, (params, xs, ys, w_ones),
+                rebind=lambda out, a: (out[0], *a[1:]),
             )
         per_round = max(total - rtt, 1e-9) / R_INNER
         rounds_per_sec = 1.0 / per_round
@@ -2600,6 +2773,14 @@ def main() -> None:
     # engine_obs_detection / engine_obs_ab).
     if "engine_obs" in tiers:
         _engine_obs_tier(extra)
+
+    # Device-side wire codec + donation tier: codec-off HLO identity,
+    # donation-clean compiled HLO + donate/no-donate byte identity,
+    # dense-vs-quant8 device-side bytes/round, quantized loss parity
+    # (extra.engine_wire_program / engine_wire_bytes /
+    # engine_wire_parity).
+    if "engine_wire" in tiers:
+        _engine_wire_tier(extra)
 
     # Async tier: FedBuff-style buffered rounds vs the synchronous
     # barrier under a 10x-skewed trainer fleet, plus the serialized

@@ -27,6 +27,7 @@ REPO = pathlib.Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))  # `tools` package import
 
 from tools.tpflcheck import (  # noqa: E402
+    check_donate,
     check_events,
     check_guards,
     check_knobs,
@@ -332,6 +333,70 @@ def test_threads_fixture(tmp_path):
     """
     root2 = _mini_repo(tmp_path / "ok", {"tpfl/runner.py": good})
     assert check_threads(root2) == []
+
+
+def test_donate_fixture(tmp_path):
+    bad = """\
+        import jax
+        import jax.numpy as jnp
+        from functools import partial
+
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def fold(acc, v):
+            return acc + v
+
+
+        def window():
+            step = jax.jit(lambda a, b: a + b, donate_argnums=(0,))
+            p = jnp.ones(3)
+            x = jnp.ones(3)
+            out = step(p, x)
+            return p + out  # p's buffer was consumed by the dispatch
+
+
+        def accumulate(vals):
+            acc = jnp.zeros(3)
+            for v in vals:
+                acc2 = fold(acc, v)
+            return acc  # donated via the DECORATED callee
+    """
+    root = _mini_repo(tmp_path, {"tpfl/engine_seam.py": bad})
+    found = check_donate(root)
+    keys = {v.key for v in found}
+    assert "donate:tpfl/engine_seam.py::window::p" in keys, [
+        v.render() for v in found
+    ]
+    assert "donate:tpfl/engine_seam.py::accumulate::acc" in keys
+    # The canonical safe shape — re-bind the name from the program's
+    # outputs — is clean, as is a donated name never read again.
+    good = """\
+        import jax
+        import jax.numpy as jnp
+        from functools import partial
+
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def fold(acc, v):
+            return acc + v
+
+
+        def window():
+            step = jax.jit(lambda a, b: a + b, donate_argnums=(0,))
+            p = jnp.ones(3)
+            x = jnp.ones(3)
+            p = step(p, x)
+            return p + x
+
+
+        def accumulate(vals):
+            acc = jnp.zeros(3)
+            for v in vals:
+                acc = fold(acc, v)
+            return acc
+    """
+    root2 = _mini_repo(tmp_path / "ok", {"tpfl/engine_seam.py": good})
+    assert check_donate(root2) == []
 
 
 def test_trace_fixture(tmp_path):

@@ -103,6 +103,83 @@ def test_topk_keeps_largest_magnitudes():
     np.testing.assert_allclose(np.asarray(back["x"]), x, atol=1e-6)
 
 
+def _parity_zoo():
+    """Dtype zoo for the jitted-vs-numpy kernel parity pins: every
+    float dtype the wire carries, plus 0-d and empty leaves."""
+    rng = np.random.default_rng(11)
+    return [
+        rng.normal(size=(16, 8)).astype(np.float32),
+        jnp.asarray(rng.normal(size=(9,)), jnp.bfloat16),
+        rng.normal(size=(4, 3)).astype(np.float16),
+        rng.normal(size=(5,)).astype(np.float64),
+        np.float32(2.5),
+        np.float32(0.0),
+        np.zeros((0, 4), np.float32),
+        np.full((4,), 1e30, np.float32),
+        np.array([2.0, -2.0, 2.0, 1.0], np.float32),  # magnitude ties
+    ]
+
+
+def test_q8_kernel_bit_equal_to_numpy_reference():
+    """The jitted device codec and the host-side numpy path must agree
+    BIT-FOR-BIT — the engine's in-program exchange and a gRPC peer's
+    decode are the same math, not merely close."""
+    for x in _parity_zoo():
+        qj, sj = compression._q8_encode(jnp.asarray(x))
+        qn, sn = compression.q8_encode_np(np.asarray(x))
+        assert np.asarray(qj).tobytes() == qn.tobytes(), np.shape(x)
+        assert np.float32(sj).tobytes() == np.float32(sn).tobytes()
+        dj = np.asarray(compression._q8_decode(qj, sj))
+        dn = compression.q8_decode_np(qn, sn)
+        assert dj.tobytes() == dn.tobytes()
+
+
+def test_topk_kernel_bit_equal_to_numpy_reference():
+    for x in _parity_zoo():
+        size = int(np.prod(np.shape(x))) if np.shape(x) else 1
+        k = max(1, min(3, size))
+        if size == 0:
+            k = 1  # guard path: empty in, empty out
+        ij, vj = compression._topk_encode(jnp.asarray(x), k)
+        inp, vn = compression.topk_encode_np(np.asarray(x), k)
+        assert np.array_equal(np.asarray(ij), inp), np.shape(x)
+        assert np.asarray(vj).tobytes() == vn.tobytes()
+
+
+def test_wire_bytes_per_model_accounting():
+    """The static accounting mirrors _encode_leaf's per-leaf policy:
+    non-float/empty dense, top-k only past one element."""
+    tree = {
+        "w": np.zeros((256, 256), np.float32),
+        "b16": np.zeros((64,), np.float16),
+        "ints": np.zeros((8,), np.int32),
+        "scalar": np.float32(1.0),
+        "empty": np.zeros((0, 4), np.float32),
+    }
+    dense = compression.wire_bytes_per_model(tree, 0)
+    assert dense == 256 * 256 * 4 + 64 * 2 + 8 * 4 + 4
+    q8 = compression.wire_bytes_per_model(tree, compression.QUANT8)
+    # floats of size>0 quantize (int8 + f32 scale); ints ride dense;
+    # the scalar quantizes too (1 + 4 bytes).
+    assert q8 == (256 * 256 + 4) + (64 + 4) + 8 * 4 + (1 + 4)
+    tk = compression.wire_bytes_per_model(
+        tree, compression.TOPK | compression.QUANT8, topk_frac=0.05
+    )
+    k = int(np.ceil(256 * 256 * 0.05))
+    k16 = int(np.ceil(64 * 0.05))
+    # top-k'd leaves: uint32 idx + int8 vals + scale; the scalar has
+    # no top-k (size 1) and falls back to quant8.
+    assert tk == (k * 4 + k + 4) + (k16 * 4 + k16 + 4) + 8 * 4 + (1 + 4)
+    # ShapeDtypeStruct leaves (the engine's trace-time form) agree.
+    import jax
+
+    structs = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(np.shape(a), np.asarray(a).dtype),
+        tree,
+    )
+    assert compression.wire_bytes_per_model(structs, 0) == dense
+
+
 def test_resolve_codec_validation():
     assert compression.resolve_codec("dense") == 0
     assert compression.resolve_codec("quant8+zlib") == (

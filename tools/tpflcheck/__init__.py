@@ -6,6 +6,9 @@ framework, shared file-walking / waiver / reporting machinery
 
     guards    guarded-by race lint (# guarded-by: annotations)
     locks     static lock-order extraction + deadlock (cycle) detection
+    donate    donated-buffer reuse lint (a jax.jit donate_argnums
+              binding must not be read after the dispatch that
+              consumed it — re-bind from the program's outputs)
     layers    SURVEY layer map (no upward module-level imports)
     knobs     Settings knob existence / profile totality / docs sync
     threads   thread-lifecycle hygiene (name= + daemon= everywhere)
@@ -37,6 +40,7 @@ from tools.tpflcheck.core import (
     load_waivers,
     repo_root,
 )
+from tools.tpflcheck.donate import check_donate
 from tools.tpflcheck.events import check_events
 from tools.tpflcheck.guards import check_guards
 from tools.tpflcheck.knobs import check_knobs
@@ -48,6 +52,7 @@ from tools.tpflcheck.trace import check_trace
 __all__ = [
     "Violation",
     "Waivers",
+    "check_donate",
     "check_events",
     "check_guards",
     "check_knobs",
@@ -76,6 +81,7 @@ def run_all(
     violations += check_threads(root)
     violations += check_trace(root)
     violations += check_events(root)
+    violations += check_donate(root)
     violations += wire.violations(root)
 
     waivers = load_waivers(root)

@@ -46,7 +46,6 @@ from __future__ import annotations
 import hashlib
 import threading
 import zlib
-from functools import partial
 from typing import Any, Callable, Optional
 
 import jax
@@ -123,28 +122,164 @@ def is_dense(spec: "str | int") -> bool:
     return resolve_codec(spec) == 0
 
 
-# --- jitted leaf kernels (arrays never bounce through Python loops) ---
+# --- device codec kernels -------------------------------------------------
+#
+# The kernels are PLAIN traceable functions so the engine's round
+# program can compose them inside its own trace (quantize -> psum of
+# dequantized gossip, tpfl.parallel.engine); the jitted wrappers below
+# (`_q8_encode` etc.) are the host payload path's entry points and lower
+# the identical math. A host-side NUMPY reference (`q8_encode_np` /
+# `topk_encode_np`) pins the semantics: the jitted kernels must
+# round-trip bit-equal to it across dtypes (tests/test_compression.py).
 
 
-@jax.jit
-def _q8_encode(x):
+def q8_encode(x):
+    """int8 symmetric per-leaf quantization: ``scale = max|x|/127``,
+    values clipped/rounded to int8. Traceable (composable inside a
+    jitted round program); empty leaves quantize to themselves at
+    scale 1. The /127 is written as an explicit reciprocal multiply:
+    XLA rewrites constant divisions that way inside fused programs,
+    so spelling it out is what keeps the lowering bit-equal to the
+    numpy reference."""
     x = x.astype(jnp.float32)
-    scale = jnp.max(jnp.abs(x)) / 127.0
+    if x.size == 0:
+        return x.astype(jnp.int8), jnp.float32(1.0)
+    scale = jnp.max(jnp.abs(x)) * jnp.float32(1.0 / 127.0)
     scale = jnp.where((scale > 0) & jnp.isfinite(scale), scale, 1.0)
     q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
     return q, scale
 
 
-@jax.jit
-def _q8_decode(q, scale):
+def q8_decode(q, scale):
     return q.astype(jnp.float32) * scale
 
 
-@partial(jax.jit, static_argnums=1)
-def _topk_encode(x, k):
+def topk_encode(x, k):
+    """Top-k by magnitude over the raveled leaf: (uint32 indices,
+    float32 values). Traceable; ties resolve lowest-index-first
+    (``lax.top_k`` is stable, matching the numpy reference)."""
     flat = x.astype(jnp.float32).ravel()
+    if flat.size == 0:
+        return jnp.zeros((0,), jnp.uint32), flat
     _, idx = jax.lax.top_k(jnp.abs(flat), k)
     return idx.astype(jnp.uint32), flat[idx]
+
+
+_q8_encode = jax.jit(q8_encode)
+_q8_decode = jax.jit(q8_decode)
+_topk_encode = jax.jit(topk_encode, static_argnums=1)
+
+
+# --- host-side numpy reference (the semantics the kernels must match) ---
+
+
+def q8_encode_np(x) -> "tuple[np.ndarray, np.float32]":
+    """Pure-numpy reference for :func:`q8_encode` — the jitted kernel
+    must round-trip bit-equal to this across dtypes (incl. bfloat16,
+    0-d and empty leaves)."""
+    x = np.asarray(x).astype(np.float32)
+    if x.size == 0:
+        return x.astype(np.int8), np.float32(1.0)
+    scale = np.float32(np.max(np.abs(x)) * np.float32(1.0 / 127.0))
+    if not (scale > 0 and np.isfinite(scale)):
+        scale = np.float32(1.0)
+    q = np.clip(np.round(x / scale), -127, 127).astype(np.int8)
+    return q, scale
+
+
+def q8_decode_np(q, scale) -> np.ndarray:
+    return np.asarray(q).astype(np.float32) * np.float32(scale)
+
+
+def topk_encode_np(x, k) -> "tuple[np.ndarray, np.ndarray]":
+    """Pure-numpy reference for :func:`topk_encode` (stable argsort ==
+    ``lax.top_k``'s lowest-index-first tie order)."""
+    flat = np.asarray(x).astype(np.float32).ravel()
+    if flat.size == 0:
+        return np.zeros((0,), np.uint32), flat
+    order = np.argsort(-np.abs(flat), kind="stable")[:k]
+    return order.astype(np.uint32), flat[order]
+
+
+# --- engine (in-program) codecs ------------------------------------------
+
+#: Codec bits the engine's round program can lower: tensor->tensor
+#: transforms only. Entropy coders (zlib/zstd) and residuals (delta)
+#: are HOST byte transforms — they have no in-program meaning.
+ENGINE_CODEC_BITS = QUANT8 | TOPK
+
+
+def resolve_engine_codec(spec: "str | int") -> int:
+    """Codec-id byte for ``Settings.ENGINE_WIRE_CODEC`` ("dense",
+    "quant8", "topk", "topk+quant8"). Raises ``ValueError`` for byte
+    transforms (zlib/zstd/delta) that cannot lower into an XLA round
+    program — at knob-selection time, not mid-window."""
+    bits = resolve_codec(spec)
+    if bits & ~ENGINE_CODEC_BITS:
+        raise ValueError(
+            f"engine wire codec {codec_name(bits)!r} includes host-side "
+            "byte transforms; the in-program codec composes only "
+            "'quant8' and 'topk'"
+        )
+    return bits
+
+
+def engine_codec_roundtrip(bits: int, topk_frac: float) -> Callable:
+    """ONE node's per-leaf wire round-trip as a traceable function —
+    the device-side form of ``_encode_leaf``/``_decode_leaf`` (same
+    leaf policy: non-float and empty leaves ride dense, top-k needs
+    more than one element), returning the leaf a RECEIVER would decode
+    (original dtype restored). The engine vmaps this over the node
+    axis so every node quantizes its own payload."""
+    if not bits & (QUANT8 | TOPK):
+        return lambda x: x
+
+    def leaf_roundtrip(x):
+        if x.size == 0 or not jnp.issubdtype(x.dtype, jnp.floating):
+            return x
+        if bits & TOPK and x.size > 1:
+            k = max(1, int(np.ceil(x.size * float(topk_frac))))
+            idx, vals = topk_encode(x, k)
+            if bits & QUANT8:
+                vals = q8_decode(*q8_encode(vals))
+            flat = jnp.zeros((x.size,), jnp.float32).at[idx].set(vals)
+            return flat.reshape(x.shape).astype(x.dtype)
+        if bits & QUANT8:
+            return q8_decode(*q8_encode(x)).astype(x.dtype)
+        return x
+
+    return leaf_roundtrip
+
+
+def wire_bytes_per_model(
+    tree: Any, bits: int, topk_frac: float = 0.05
+) -> int:
+    """Tensor payload bytes ONE node's model ships per exchange under
+    a codec — values plus scales/indices, not envelope/framing
+    overhead. Mirrors ``_encode_leaf``'s per-leaf policy exactly
+    (non-float/empty dense, top-k only past one element), so the
+    engine's device-side ``wire_bytes`` series and the host payload
+    path can never disagree on what a codec saves. Leaves may be
+    arrays or ``jax.ShapeDtypeStruct``\\ s."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shape = tuple(getattr(leaf, "shape", np.shape(leaf)))
+        dtype = np.dtype(getattr(leaf, "dtype", np.asarray(leaf).dtype))
+        size = int(np.prod(shape)) if shape else 1
+        if size == 0:
+            continue
+        floaty = jnp.issubdtype(dtype, jnp.floating)
+        if not floaty or not bits & (QUANT8 | TOPK):
+            total += size * dtype.itemsize
+        elif bits & TOPK and size > 1:
+            k = max(1, int(np.ceil(size * float(topk_frac))))
+            total += k * 4  # uint32 indices
+            total += (k * 1 + 4) if bits & QUANT8 else k * 4
+        elif bits & QUANT8:
+            total += size * 1 + 4  # int8 values + f32 scale
+        else:
+            total += size * dtype.itemsize
+    return total
 
 
 def _fp_update(h, arr: np.ndarray) -> None:

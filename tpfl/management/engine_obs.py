@@ -103,6 +103,10 @@ def replay_window(
     mnorm = np.asarray(telemetry["model_norm"], np.float64)
     part = np.asarray(telemetry["participation"], np.float64)
     wmass = np.asarray(telemetry["weight_mass"], np.float64)
+    # Device-side exchange bytes (the ENGINE_WIRE_CODEC accounting);
+    # absent from pre-codec carries.
+    wire = telemetry.get("wire_bytes")
+    wire = None if wire is None else np.asarray(wire, np.float64)
     n_rounds = int(loss.shape[0])
     names = list(peers) if peers is not None else peer_names(n_nodes)
     w = None if weights is None else np.asarray(weights, np.float64)
@@ -173,6 +177,16 @@ def replay_window(
         "tpfl_engine_participation", float(part[last]), labels=labels
     )
     metrics.gauge("tpfl_engine_weight_mass", float(wmass[last]), labels=labels)
+    if wire is not None:
+        # Gauge = last round's bytes (what a scrape reads as "the
+        # exchange currently costs"); counter = the window's total, so
+        # the multichip tier can gate cumulative bytes/round ratios.
+        metrics.gauge(
+            "tpfl_engine_wire_bytes", float(wire[last]), labels=labels
+        )
+        metrics.counter(
+            "tpfl_engine_wire_bytes_total", float(wire.sum()), labels=labels
+        )
     if flagged:
         metrics.counter(
             "tpfl_engine_flagged_total", float(flagged), labels=labels

@@ -582,22 +582,61 @@ def measure_dispatch_rtt(best_of: int = 3) -> float:
     return rtt
 
 
-def best_of_wall(fn: Callable, args: tuple, n: int = 3) -> tuple[float, Any]:
-    """Best-of-n wall time of ``fn(*args)`` with a SCALAR host sync on
-    the last output leaf (perf_cnn.md round-5 trap #1: syncing by
-    copying an array carry measures the tunnel, not the device).
-    Returns ``(best_seconds, last_outputs)``. The first call is a
-    discarded compile/warm run."""
+def _sync_scalar(out: Any) -> None:
+    """The one host sync both wall timers share: copy 4 bytes of the
+    LAST output leaf (perf_cnn.md round-5 trap #1 — syncing by copying
+    an array carry measures the tunnel, not the device)."""
     import jax
     import numpy as np
 
-    out = fn(*args)  # compile + warm
     float(np.asarray(jax.tree_util.tree_leaves(out)[-1]).ravel()[0])
+
+
+def best_of_wall(fn: Callable, args: tuple, n: int = 3) -> tuple[float, Any]:
+    """Best-of-n wall time of ``fn(*args)`` with a SCALAR host sync on
+    the last output leaf. Returns ``(best_seconds, last_outputs)``.
+    The first call is a discarded compile/warm run. ``fn`` must NOT
+    donate its inputs — every iteration re-feeds the same buffers; for
+    a donating program use :func:`best_of_wall_donated`."""
+    out = fn(*args)  # compile + warm
+    _sync_scalar(out)
     best = float("inf")
     for _ in range(max(1, n)):
         t0 = time.perf_counter()
         out = fn(*args)
-        float(np.asarray(jax.tree_util.tree_leaves(out)[-1]).ravel()[0])
+        _sync_scalar(out)
+        best = min(best, time.perf_counter() - t0)
+    return best, out
+
+
+def best_of_wall_donated(
+    fn: Callable,
+    args: tuple,
+    rebind: Callable[[Any, tuple], tuple],
+    n: int = 3,
+) -> tuple[float, Any]:
+    """:func:`best_of_wall` for a program that DONATES input buffers:
+    each call consumes (part of) its arguments, so iterations cannot
+    re-feed ``args`` verbatim — ``rebind(last_outputs, prev_args) ->
+    args`` re-materializes the consumed inputs for the next iteration,
+    typically by threading the program's own outputs back in (the
+    production shape: window N+1 trains from window N's fold, e.g.
+    ``lambda out, a: (out[0], *a[1:])``). Rebinding and buffer
+    materialization happen OUTSIDE the timed region
+    (``block_until_ready`` before the clock starts), so the measured
+    wall is the donating program itself — the real engine path, not a
+    ``donate=False`` stand-in built just to be timeable."""
+    import jax
+
+    out = fn(*args)  # compile + warm (consumes the caller's buffers)
+    _sync_scalar(out)
+    best = float("inf")
+    for _ in range(max(1, n)):
+        args = rebind(out, args)
+        jax.block_until_ready(args)
+        t0 = time.perf_counter()
+        out = fn(*args)
+        _sync_scalar(out)
         best = min(best, time.perf_counter() - t0)
     return best, out
 

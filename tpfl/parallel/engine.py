@@ -24,6 +24,13 @@ program; BlazeFL's bar: the fast path stays seed-deterministic):
   padded with zero-weight clone rows (``tpfl.parallel.mesh`` helpers);
   the masked-mean fold ignores w=0 entries exactly, so padding is
   numerics-free and every chip keeps an equal shard.
+- **Device-side wire codecs** — ``Settings.ENGINE_WIRE_CODEC`` lowers
+  the PR-1 payload codecs INTO the round program: each node's trained
+  params pass a per-leaf int8-quantize→dequantize (and/or top-k mask)
+  round-trip before the gossip psum, so the exchange leg ships
+  int8/sparse tensors over ICI/DCN natively and ``wire_bytes``
+  becomes a device-side carry series. "dense" (default) lowers the
+  byte-identical pre-codec program (separate cache slot).
 - **In-program telemetry** — ``Settings.ENGINE_TELEMETRY`` threads a
   fixed-shape ``[n_rounds, ...]`` carry through the window (per round
   and per node: loss, update norm, reference cosine; per round:
@@ -50,6 +57,7 @@ windows), and ``bench.py``'s ``multichip`` tier.
 
 from __future__ import annotations
 
+import re
 import time
 from typing import Any, Callable, Optional
 
@@ -60,6 +68,7 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from tpfl.learning import compression
 from tpfl.learning.jax_learner import (
     TrainState,
     cross_entropy_loss,
@@ -90,7 +99,8 @@ _ALGORITHMS = ("fedavg", "fedprox", "scaffold")
 #: ``[n_rounds]`` scalars.
 TELEMETRY_NODE_FIELDS = ("loss", "update_norm", "cos_ref")
 TELEMETRY_ROUND_FIELDS = (
-    "delta_norm", "model_norm", "participation", "weight_mass"
+    "delta_norm", "model_norm", "participation", "weight_mass",
+    "wire_bytes",
 )
 TELEMETRY_FIELDS = TELEMETRY_NODE_FIELDS + TELEMETRY_ROUND_FIELDS
 
@@ -534,7 +544,8 @@ class FederationEngine:
 
     def _build_multi(
         self, kind: str, epochs: int, n_rounds: int, w_ndim: int,
-        telemetry: bool = False, a_ndim: int = 0,
+        telemetry: bool = False, a_ndim: int = 0, codec: int = 0,
+        topk_frac: float = 0.05,
     ) -> Callable:
         """The UNJITTED federation program (shard_map-wrapped on a
         mesh): ``fn(params, c_locals, c_global, aux, xs, ys, weights,
@@ -563,12 +574,30 @@ class FederationEngine:
         fold — the in-program lowering of ``AttackPlan``'s sign-flip
         schedule (``scale = 1 − 2α``), so the telemetry carry observes
         engine-tier adversaries exactly where the gRPC tier's ledger
-        observes protocol-tier ones."""
+        observes protocol-tier ones.
+
+        ``codec`` (the ``ENGINE_WIRE_CODEC`` variant): a device-side
+        wire codec for the gossip exchange — each node's trained
+        params pass the per-leaf quantize→dequantize (int8) or top-k
+        mask round-trip IN-PROGRAM before the fold's psum, so the
+        exchange leg ships int8/sparse tensors over ICI/DCN natively
+        (``tpfl.learning.compression.engine_codec_roundtrip``, vmapped
+        over the node axis: every node quantizes its own payload, and
+        telemetry stats observe what a receiver would decode — the
+        gRPC tier's intake semantics). Params only: aux stats and
+        SCAFFOLD variates ride dense (per-node state, not the model
+        payload). ``codec=0`` is Python-level elision like
+        ``telemetry=False`` — the dense program lowers byte-identical
+        to the pre-codec path. The telemetry carry's ``wire_bytes``
+        row is the exchange's per-round tensor payload bytes
+        (participating nodes × the codec's per-model bytes,
+        ``compression.wire_bytes_per_model``) computed device-side."""
         local_train = self._build_local_train(kind)
         mesh = self.mesh
         sharded = mesh is not None and mesh_axis_size(mesh) > 1
         psum_axis = NODE_AXIS if sharded else None
         fold = self._build_fold(kind, psum_axis)
+        codec_fn = compression.engine_codec_roundtrip(codec, topk_frac)
         f32 = jnp.float32
 
         def per_node_sq(tree):
@@ -616,6 +645,14 @@ class FederationEngine:
                     ),
                     trained,
                 )
+            if codec:
+                # The exchange leg: every node's contribution passes
+                # the wire round-trip BEFORE stats and fold, so the
+                # telemetry carry and the psum both see exactly what a
+                # receiver would decode.
+                trained = jax.tree_util.tree_map(
+                    lambda t: jax.vmap(codec_fn)(t), trained
+                )
             if telemetry:
                 upd = jax.tree_util.tree_map(
                     lambda t, p: t.astype(f32) - p.astype(f32),
@@ -653,6 +690,21 @@ class FederationEngine:
                     moved_sq = moved_sq + jnp.sum((o0 - p0) ** 2)
                     out_sq = out_sq + jnp.sum(o0 * o0)
                 zero = jnp.zeros((valid.shape[0],), f32)
+                participation = psum_(jnp.sum((w > 0).astype(f32)))
+                # Per-node wire payload bytes under the active codec —
+                # a static constant of the leaf shapes (computed at
+                # trace time from the SAME per-leaf policy the host
+                # payload path applies); the per-round series is
+                # participation-dependent and rides the carry.
+                bpm = compression.wire_bytes_per_model(
+                    jax.tree_util.tree_map(
+                        lambda t: jax.ShapeDtypeStruct(
+                            t.shape[1:], t.dtype
+                        ),
+                        trained,
+                    ),
+                    codec, topk_frac,
+                )
                 round_stats = {
                     "delta_norm": masked_mean(
                         zero.at[0].set(jnp.sqrt(moved_sq)), first
@@ -660,10 +712,9 @@ class FederationEngine:
                     "model_norm": masked_mean(
                         zero.at[0].set(jnp.sqrt(out_sq)), first
                     ),
-                    "participation": psum_(
-                        jnp.sum((w > 0).astype(f32))
-                    ),
+                    "participation": participation,
                     "weight_mass": psum_(jnp.sum(w.astype(f32))),
+                    "wire_bytes": participation * f32(bpm),
                 }
                 return (
                     out_params, out_c, out_cg, out_aux, losses,
@@ -682,6 +733,7 @@ class FederationEngine:
                 "model_norm": per_round,
                 "participation": per_round,
                 "weight_mass": per_round,
+                "wire_bytes": per_round,
             }
 
         def tele_write(tele, r, losses, node_stats, round_stats):
@@ -759,6 +811,7 @@ class FederationEngine:
                     "model_norm": repl,
                     "participation": repl,
                     "weight_mass": repl,
+                    "wire_bytes": repl,
                 },
             )
         return shard_map(
@@ -770,22 +823,33 @@ class FederationEngine:
         )
 
     def raw_program(
-        self, kind: str, epochs: int, n_rounds: int = 1, w_ndim: int = 1
+        self, kind: str, epochs: int, n_rounds: int = 1, w_ndim: int = 1,
+        codec: int = 0, topk_frac: float = 0.05,
     ) -> Callable:
         """Cached UNJITTED program (shard_map-wrapped on a mesh) for
-        tracing inside a caller's own jit."""
-        key = ("raw", kind, int(epochs), int(n_rounds), int(w_ndim))
+        tracing inside a caller's own jit. ``codec`` selects the
+        device-side wire-codec variant (separate cache slot — the same
+        key hygiene as the jitted programs)."""
+        key = (
+            "raw", kind, int(epochs), int(n_rounds), int(w_ndim),
+            int(codec), float(topk_frac),
+        )
         fn = self._programs.get(key)
         if fn is None:
-            fn = self._programs[key] = self._build_multi(*key[1:])
+            fn = self._programs[key] = self._build_multi(
+                kind, int(epochs), int(n_rounds), int(w_ndim),
+                codec=int(codec), topk_frac=float(topk_frac),
+            )
         return fn
 
     def _build_program(
         self, kind: str, epochs: int, n_rounds: int, w_ndim: int,
         donate: bool = True, telemetry: bool = False, a_ndim: int = 0,
+        codec: int = 0, topk_frac: float = 0.05,
     ) -> Callable:
         multi = self._build_multi(
-            kind, epochs, n_rounds, w_ndim, telemetry, a_ndim
+            kind, epochs, n_rounds, w_ndim, telemetry, a_ndim, codec,
+            topk_frac,
         )
         dn = (0, 1, 2, 3) if donate else ()
         mesh = self.mesh
@@ -809,6 +873,7 @@ class FederationEngine:
                     "model_norm": rs,
                     "participation": rs,
                     "weight_mass": rs,
+                    "wire_bytes": rs,
                 },
             )
         return jax.jit(
@@ -821,21 +886,26 @@ class FederationEngine:
     def program(
         self, kind: str, epochs: int, n_rounds: int = 1, w_ndim: int = 1,
         donate: bool = True, telemetry: bool = False, a_ndim: int = 0,
+        codec: int = 0, topk_frac: float = 0.05,
     ) -> Callable:
         """Cached compiled program for ``(kind, epochs, n_rounds,
         w_ndim)`` — the raw jitted callable (bench drives these from
         inside its own timed loops). ``donate=False`` builds a
         NON-donating variant (separate cache slot): repeated-call
-        benchmarking (``best_of_wall``) re-feeds the same input
-        buffers, which a donating program would have consumed.
-        ``telemetry``/``a_ndim`` select the ENGINE_TELEMETRY carry /
-        attack-scale variants — separate cache slots, so toggling the
-        knob between windows never mutates an already-compiled
-        program and the disabled program stays the byte-identical
-        pre-telemetry lowering."""
+        benchmarking over FIXED buffers (``best_of_wall``) re-feeds
+        inputs a donating program would have consumed — the donating
+        path is timed by ``best_of_wall_donated``, which re-binds.
+        ``telemetry``/``a_ndim``/``codec`` select the ENGINE_TELEMETRY
+        carry / attack-scale / ENGINE_WIRE_CODEC variants — every
+        variant axis (donation mode included) is part of the cache
+        key, so toggling a knob between windows never mutates an
+        already-compiled program: the disabled program stays the
+        byte-identical pre-telemetry (and pre-codec) lowering.
+        ``topk_frac`` is in the key because top-k's ``k`` is a static
+        constant of the compiled program."""
         key = (
             kind, int(epochs), int(n_rounds), int(w_ndim), bool(donate),
-            bool(telemetry), int(a_ndim),
+            bool(telemetry), int(a_ndim), int(codec), float(topk_frac),
         )
         fn = self._programs.get(key)
         profiling.observatory.cache_event("engine_programs", hit=fn is not None)
@@ -846,19 +916,24 @@ class FederationEngine:
     def _wrapped_program(
         self, kind: str, epochs: int, n_rounds: int, w_ndim: int,
         donate: bool = True, telemetry: bool = False, a_ndim: int = 0,
+        codec: int = 0, topk_frac: float = 0.05,
     ) -> Callable:
         """The same program behind the compile observatory's recompile
         detection (keyed per (engine program, abstract shapes) like
         every other jit seam). Variant programs get their own names —
-        the telemetry/attack signatures differ by construction and must
-        not read as recompile storms of the base program."""
+        the telemetry/attack/codec signatures differ by construction
+        and must not read as recompile storms of the base program."""
         key = (
             kind, int(epochs), int(n_rounds), int(w_ndim), bool(donate),
-            bool(telemetry), int(a_ndim),
+            bool(telemetry), int(a_ndim), int(codec), float(topk_frac),
         )
         fn = self._wrapped.get(key)
         if fn is None:
-            suffix = (":obs" if telemetry else "") + (":atk" if a_ndim else "")
+            suffix = (
+                (":obs" if telemetry else "")
+                + (":atk" if a_ndim else "")
+                + (f":{compression.codec_name(codec)}" if codec else "")
+            )
             fn = self._wrapped[key] = profiling.observatory.wrap(
                 self.program(*key),
                 f"engine_round:{kind}x{n_rounds}{suffix}:"
@@ -868,64 +943,33 @@ class FederationEngine:
 
     # --- execution -------------------------------------------------------
 
-    def round(
-        self,
-        params: Any,
-        xs: Any,
-        ys: Any,
-        weights: Optional[Any] = None,
-        epochs: int = 1,
-        aux: Optional[Any] = None,
-        scaffold_state: Optional[tuple[Any, Any]] = None,
-    ) -> tuple[Any, ...]:
-        """One federated round (``run_rounds`` with a window of 1 —
-        the single-round program carries no loop wrapper, so it is the
-        exact legacy ``VmapFederation.round`` computation)."""
-        return self.run_rounds(
-            params, xs, ys, weights=weights, epochs=epochs, n_rounds=1,
-            aux=aux, scaffold_state=scaffold_state,
+    def _resolve_variant(self) -> tuple[bool, int, float]:
+        """(telemetry, codec bits, top-k fraction) from the Settings
+        knobs — read per dispatch and folded into the program cache
+        key, so a knob flip between windows selects a different cache
+        slot instead of mutating a compiled program."""
+        return (
+            bool(Settings.ENGINE_TELEMETRY),
+            compression.resolve_engine_codec(Settings.ENGINE_WIRE_CODEC),
+            float(Settings.WIRE_TOPK_FRAC),
         )
 
-    def run_rounds(
+    def _prepare_args(
         self,
         params: Any,
         xs: Any,
         ys: Any,
-        weights: Optional[Any] = None,
-        epochs: int = 1,
-        n_rounds: int = 1,
-        aux: Optional[Any] = None,
-        scaffold_state: Optional[tuple[Any, Any]] = None,
-        donate: bool = True,
-        attack_scales: Optional[Any] = None,
-    ) -> tuple[Any, ...]:
-        """Run ``n_rounds`` federation rounds in ONE device dispatch.
-
-        ``weights``: [n] per-node FedAvg weight (0 = not elected),
-        or [n_rounds, n] for per-round participation; None = uniform
-        full participation. Data is reused across the window's rounds
-        (the bench/simulation semantics; re-stack between windows for
-        fresh data). ``donate=False`` keeps the input buffers alive
-        (repeated-call benchmarking over the same arrays).
-
-        ``attack_scales`` ([n] or [n_rounds, n], bench/test machinery):
-        per-node multipliers applied to each node's TRAINED params
-        before the fold — the in-program seeded adversary
-        (``AttackPlan.engine_scales``); None (default) compiles no
-        attack machinery at all.
-
-        With ``Settings.ENGINE_TELEMETRY`` the window runs the
-        telemetry-carry program variant and, at window close, fans the
-        device-resident per-round stats out into the observatory planes
-        (:mod:`tpfl.management.engine_obs`); the returned tuple is
-        UNCHANGED — telemetry is read-only over the carry, and the
-        model outputs stay byte-identical to the disabled program's.
-
-        Returns (params, losses) — with ``aux`` (possibly ``{}``)
-        (params, aux, losses) — and for algorithm="scaffold"
-        (params, aux, (c_locals, c_global), losses), matching
-        ``VmapFederation.round``. ``losses`` is the LAST round's
-        per-node loss vector (padded length)."""
+        weights: Optional[Any],
+        n_rounds: int,
+        aux: Optional[Any],
+        scaffold_state: Optional[tuple[Any, Any]],
+        attack_scales: Optional[Any],
+    ) -> tuple[str, list, Any, Optional[Any]]:
+        """Pad, validate and PLACE one window's inputs — the one
+        argument-prep path shared by :meth:`run_rounds` and
+        :meth:`donation_report`, so the donation inspection can never
+        drift from the buffers the real dispatch donates. Returns
+        ``(kind, args, padded weights, padded attack scales)``."""
         kind = self._kind(aux)
         if kind == "scaffold" and scaffold_state is None:
             raise ValueError(
@@ -978,14 +1022,122 @@ class FederationEngine:
                         self.mesh, PartitionSpec(None, NODE_AXIS)
                     ),
                 )
-        tele_on = bool(Settings.ENGINE_TELEMETRY)
+        args = [params, c_locals, c_global, a, xs, ys, w, self.valid]
+        if scales is not None:
+            args.append(scales)
+        return kind, args, w, scales
+
+    def donation_report(
+        self,
+        params: Any,
+        xs: Any,
+        ys: Any,
+        weights: Optional[Any] = None,
+        epochs: int = 1,
+        n_rounds: int = 1,
+        aux: Optional[Any] = None,
+        scaffold_state: Optional[tuple[Any, Any]] = None,
+    ) -> dict:
+        """Compiled-HLO buffer-donation inspection of the DONATING
+        round program this engine would dispatch for these inputs
+        (same ``_prepare_args`` path, same Settings-resolved
+        telemetry/codec variant): lowers and compiles the program and
+        verifies every donated state leaf (params, SCAFFOLD variates,
+        aux) is aliased to an output buffer end-to-end — the
+        train+fold fusion costs no staging copy of the model state.
+        See :func:`donation_analysis` for the report schema; CI gates
+        ``clean``."""
+        kind, args, w, _ = self._prepare_args(
+            params, xs, ys, weights, n_rounds, aux, scaffold_state, None
+        )
+        tele_on, codec, frac = self._resolve_variant()
+        fn = self.program(
+            kind, epochs, n_rounds, w.ndim, donate=True,
+            telemetry=tele_on, codec=codec, topk_frac=frac,
+        )
+        return donation_analysis(fn, tuple(args))
+
+    def round(
+        self,
+        params: Any,
+        xs: Any,
+        ys: Any,
+        weights: Optional[Any] = None,
+        epochs: int = 1,
+        aux: Optional[Any] = None,
+        scaffold_state: Optional[tuple[Any, Any]] = None,
+    ) -> tuple[Any, ...]:
+        """One federated round (``run_rounds`` with a window of 1 —
+        the single-round program carries no loop wrapper, so it is the
+        exact legacy ``VmapFederation.round`` computation)."""
+        return self.run_rounds(
+            params, xs, ys, weights=weights, epochs=epochs, n_rounds=1,
+            aux=aux, scaffold_state=scaffold_state,
+        )
+
+    def run_rounds(
+        self,
+        params: Any,
+        xs: Any,
+        ys: Any,
+        weights: Optional[Any] = None,
+        epochs: int = 1,
+        n_rounds: int = 1,
+        aux: Optional[Any] = None,
+        scaffold_state: Optional[tuple[Any, Any]] = None,
+        donate: Optional[bool] = None,
+        attack_scales: Optional[Any] = None,
+    ) -> tuple[Any, ...]:
+        """Run ``n_rounds`` federation rounds in ONE device dispatch.
+
+        ``weights``: [n] per-node FedAvg weight (0 = not elected),
+        or [n_rounds, n] for per-round participation; None = uniform
+        full participation. Data is reused across the window's rounds
+        (the bench/simulation semantics; re-stack between windows for
+        fresh data). ``donate`` defaults to ``Settings.ENGINE_DONATE``
+        (True: the program consumes the state buffers it was handed —
+        params/variates/aux alias the outputs in-place, no staging
+        copy; verify with :meth:`donation_report`); ``donate=False``
+        keeps the input buffers alive (repeated-call benchmarking over
+        the same arrays — ``profiling.best_of_wall``'s contract).
+
+        With ``Settings.ENGINE_WIRE_CODEC`` != "dense" the window runs
+        the device-codec program variant: every node's contribution
+        passes the int8-quantize / top-k wire round-trip in-program
+        before the gossip psum, and (with telemetry on) the carry's
+        ``wire_bytes`` row records the exchange's per-round payload
+        bytes. "dense" compiles the byte-identical pre-codec program.
+
+        ``attack_scales`` ([n] or [n_rounds, n], bench/test machinery):
+        per-node multipliers applied to each node's TRAINED params
+        before the fold — the in-program seeded adversary
+        (``AttackPlan.engine_scales``); None (default) compiles no
+        attack machinery at all.
+
+        With ``Settings.ENGINE_TELEMETRY`` the window runs the
+        telemetry-carry program variant and, at window close, fans the
+        device-resident per-round stats out into the observatory planes
+        (:mod:`tpfl.management.engine_obs`); the returned tuple is
+        UNCHANGED — telemetry is read-only over the carry, and the
+        model outputs stay byte-identical to the disabled program's.
+
+        Returns (params, losses) — with ``aux`` (possibly ``{}``)
+        (params, aux, losses) — and for algorithm="scaffold"
+        (params, aux, (c_locals, c_global), losses), matching
+        ``VmapFederation.round``. ``losses`` is the LAST round's
+        per-node loss vector (padded length)."""
+        kind, args, w, scales = self._prepare_args(
+            params, xs, ys, weights, n_rounds, aux, scaffold_state,
+            attack_scales,
+        )
+        if donate is None:
+            donate = bool(Settings.ENGINE_DONATE)
+        tele_on, codec, frac = self._resolve_variant()
         a_ndim = 0 if scales is None else int(scales.ndim)
         fn = self._wrapped_program(
-            kind, epochs, n_rounds, w.ndim, donate, tele_on, a_ndim
+            kind, epochs, n_rounds, w.ndim, donate, tele_on, a_ndim,
+            codec, frac,
         )
-        args = [params, c_locals, c_global, a, xs, ys, w, self.valid]
-        if a_ndim:
-            args.append(scales)
 
         prof = profiling.rounds.enabled()
         node_tag = f"engine:{profiling.module_tag(self.module)}"
@@ -1105,6 +1257,53 @@ class FederationEngine:
             self.pad_stacked(params), self.pad_stacked(xs),
             self.pad_stacked(ys),
         )
+
+
+# --- buffer-donation inspection ------------------------------------------
+
+
+def donation_analysis(
+    jitted_fn: Callable,
+    args: tuple,
+    donate_argnums: tuple[int, ...] = (0, 1, 2, 3),
+) -> dict:
+    """Inspect a jitted program's buffer donation through BOTH compiler
+    stages: the JAX lowering (every donated leaf must carry a
+    ``tf.aliasing_output`` marker — a ``jax.buffer_donor`` marker means
+    JAX accepted the donation but found no aliasable output, i.e. the
+    buffer is freed, not reused) and the compiled HLO's
+    ``input_output_alias`` table (the executable actually writes
+    outputs into the donated input buffers). Returns::
+
+        {"donated_leaves": int,   # array leaves under donate_argnums
+         "aliased": int,          # tf.aliasing_output markers
+         "unaliased_donors": int, # jax.buffer_donor markers
+         "output_aliases": int,   # compiled input_output_alias pairs
+         "clean": bool}           # all three columns agree
+
+    ``clean`` is the CI gate: a donating round program that stages a
+    copy (or silently drops a donation) regresses it."""
+    donated_leaves = len(
+        jax.tree_util.tree_leaves(tuple(args[i] for i in donate_argnums))
+    )
+    low = jitted_fn.lower(*args)
+    txt = low.as_text()
+    aliased = txt.count("tf.aliasing_output")
+    donors = txt.count("jax.buffer_donor")
+    header = low.compile().as_text().splitlines()[0]
+    m = re.search(r"input_output_alias=\{(.*?)\s\}", header)
+    output_aliases = len(re.findall(r"\(\d+,", m.group(1))) if m else 0
+    return {
+        "donated_leaves": donated_leaves,
+        "aliased": aliased,
+        "unaliased_donors": donors,
+        "output_aliases": output_aliases,
+        "clean": bool(
+            donors == 0
+            and aliased == donated_leaves
+            and output_aliases == donated_leaves
+        ),
+    }
 
 
 # --- batched-fit programs (the pool's side of the seam) ------------------

@@ -260,15 +260,19 @@ class VmapFederation:
         n_rounds: int = 1,
         aux: Optional[Any] = None,
         scaffold_state: Optional[tuple[Any, Any]] = None,
-        donate: bool = True,
+        donate: Optional[bool] = None,
     ) -> tuple[Any, ...]:
         """``n_rounds`` federated rounds in ONE device dispatch (the
         engine's ``lax.fori_loop`` window — host dispatch RTT paid once
         per window, ``Settings.SHARD_ROUNDS_PER_DISPATCH`` sizes it for
         the learner integrations). Return conventions match
         :meth:`round`; ``n_rounds=1`` is the identical program.
-        ``donate=False`` keeps input buffers alive (repeated-call
-        benchmarking — the primary tier's ``best_of_wall`` windows)."""
+        ``donate`` defaults to ``Settings.ENGINE_DONATE`` (the state
+        buffers alias the outputs in place); ``donate=False`` keeps
+        input buffers alive (repeated-call benchmarking over fixed
+        arrays — ``profiling.best_of_wall``'s contract; the primary
+        tier times the DONATING program via
+        ``profiling.best_of_wall_donated``)."""
         return self.engine.run_rounds(
             params, xs, ys, weights=weights, epochs=epochs,
             n_rounds=n_rounds, aux=aux, scaffold_state=scaffold_state,

@@ -654,6 +654,40 @@ class Settings:
     over the carry. Read at program-build time (per run_rounds
     call). See docs/observability.md "Engine plane"."""
 
+    ENGINE_WIRE_CODEC: str = "dense"
+    """Device-side wire codec for the engine's gossip exchange
+    (tpfl.parallel.engine + tpfl.learning.compression): "dense"
+    (default), "quant8", "topk", or "topk+quant8". Non-dense lowers
+    the PR-1 payload codec INTO the fused round program — each node's
+    trained params pass the per-leaf int8-quantize→dequantize (or
+    top-k mask) round-trip in-program before the fold's ``lax.psum``,
+    so the exchange leg ships int8/sparse tensors over ICI/DCN
+    natively (~4x fewer exchange bytes for f32 under quant8) and the
+    ENGINE_TELEMETRY carry's ``wire_bytes`` row records bytes/round
+    device-side (``tpfl_engine_wire_bytes``). LOSSY like the host-side
+    WIRE_CODEC it mirrors (same kernels, same per-leaf policy — the
+    bench gates loss parity); "dense" compiles the byte-identical
+    pre-codec program (separate program-cache slot, HLO-digest-stable
+    across toggles). Entropy coders (zlib/zstd) and delta are host
+    byte transforms and are rejected here at knob-read time. Read at
+    program-build time (per run_rounds call); the top-k fraction
+    rides ``WIRE_TOPK_FRAC``. See docs/scaling.md "Device-side wire
+    codecs"."""
+
+    ENGINE_DONATE: bool = True
+    """Default donation mode for the engine's round program
+    (``FederationEngine.run_rounds(donate=None)``): True donates the
+    state buffers (params, SCAFFOLD variates, aux) to the dispatch —
+    XLA writes the fold's outputs INTO the input buffers, so a window
+    costs no staging copy of the model state and peak HBM stays
+    one-model-deep (verify with ``FederationEngine.donation_report``;
+    the engine_wire bench tier gates donation-clean HLO and
+    byte-identical outputs vs the non-donating variant). The handed-in
+    buffers are CONSUMED — callers that re-feed the same arrays
+    (repeated-call benchmarking) pass ``donate=False`` explicitly or
+    rebind from the outputs (``profiling.best_of_wall_donated``).
+    False: every dispatch allocates fresh outputs (debugging aid)."""
+
     # --- concurrency diagnostics ---
     LOCK_TRACING: bool = False
     """Opt-in runtime lock-order tracing (tpfl.concurrency): every lock
@@ -801,6 +835,13 @@ class Settings:
         # keeps the engine's round program byte-identical to the
         # reference path.
         cls.ENGINE_TELEMETRY = False
+        # Exactness first in tests (the WIRE_CODEC rule above applies
+        # on-device too): dense in-program exchange; codec tests opt in
+        # per-case. Donation stays on — it is the production path and
+        # never changes numerics (the engine_wire tests pin byte
+        # identity vs donate=False).
+        cls.ENGINE_WIRE_CODEC = "dense"
+        cls.ENGINE_DONATE = True
 
     @classmethod
     def set_standalone_settings(cls) -> None:
@@ -907,6 +948,11 @@ class Settings:
         # profiling: enable it for engine-window runs you intend to
         # read attribution / convergence / ledger verdicts from.
         cls.ENGINE_TELEMETRY = False
+        # Reference parity over bytes on a single host: the exchange
+        # stays exact-dense in-program, and donation (numerics-free)
+        # stays on.
+        cls.ENGINE_WIRE_CODEC = "dense"
+        cls.ENGINE_DONATE = True
 
     @classmethod
     def set_scale_settings(cls) -> None:
@@ -1070,6 +1116,13 @@ class Settings:
         # so like the other observability knobs it stays an explicit
         # opt-in at this profile's node counts.
         cls.ENGINE_TELEMETRY = False
+        # The scale profile already ships quant8 on the host wire
+        # (WIRE_CODEC above) — the in-program exchange follows suit:
+        # cross-host/sharded gossip psums int8-round-tripped tensors
+        # natively (~4x fewer exchange bytes at the bench-gated loss
+        # parity). Donation on: O(1)-model HBM per window.
+        cls.ENGINE_WIRE_CODEC = "quant8"
+        cls.ENGINE_DONATE = True
 
     @classmethod
     def snapshot(cls) -> dict[str, Any]:
