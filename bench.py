@@ -576,8 +576,10 @@ def _analysis_tier(extra: dict) -> None:
     """Analysis tier (tools/tpflcheck + tpfl.concurrency). Two reports:
 
     - extra.analysis_static: wall-time of the full tpflcheck suite
-      (guards/locks/layers/knobs/threads/wire) over the tree — budget
-      < 5 s, zero unwaived violations.
+      (guards/locks/capture/spmd/sync/layers/knobs/threads/trace/
+      events/donate/wire) over the tree — budget < 5 s, zero unwaived
+      violations, plus per-pass counts for the JAX-semantics passes
+      (capture/spmd/sync must each be clean — CI-gated).
     - extra.analysis_lock_trace: the same seeded 3-node digits
       federation run with Settings.LOCK_TRACING off and then on —
       the traced run must finish with an ACYCLIC runtime acquisition
@@ -593,15 +595,34 @@ def _analysis_tier(extra: dict) -> None:
     from tpfl.settings import Settings
 
     try:
-        from tools.tpflcheck import run_all
+        from tools.tpflcheck import (
+            check_capture,
+            check_spmd,
+            check_sync,
+            run_all,
+        )
 
         t0 = time.monotonic()
         violations, waived, warnings, _ = run_all(root)
         wall = time.monotonic() - t0
+        # Per-pass violation counts for the JAX-semantics passes
+        # (ISSUE 14) — gated alongside the suite-wide zero: a pass
+        # whose count creeps up is a regression even while waived.
+        t1 = time.monotonic()
+        per_pass = {
+            "capture": len(check_capture(root)),
+            "spmd": len(check_spmd(root)),
+            "sync": len(check_sync(root)),
+        }
+        jax_passes_wall = time.monotonic() - t1
         extra["analysis_static"] = {
             "wall_s": round(wall, 2),
             "within_5s_budget": bool(wall < 5.0),
             "violations": len(violations),
+            "zero_violations": not violations,
+            "jax_pass_violations": per_pass,
+            "jax_passes_clean": not any(per_pass.values()),
+            "jax_passes_wall_s": round(jax_passes_wall, 2),
             "waived": len(waived),
             "warnings": len(warnings),
         }

@@ -1,4 +1,5 @@
-"""tpflcheck analysis-suite tests (ISSUE 4).
+"""tpflcheck analysis-suite tests (ISSUE 4, JAX-semantics passes +
+TRACE_CONTRACTS from ISSUE 14).
 
 Three layers of coverage:
 
@@ -6,13 +7,18 @@ Three layers of coverage:
    is how the suite is wired into tier-1.
 2. The analyzer itself works: for each check, a fixture snippet that
    MUST fail (seeded guarded-by violation, lock-order cycle, upward
-   import, unknown knob, unnamed thread) and the corrected version
-   that must pass. An analyzer that silently stopped finding anything
-   would otherwise look exactly like a clean tree.
-3. The runtime half: TracedLock cycle detection as a unit test, and a
-   chaos-marked e2e federation with ``Settings.LOCK_TRACING = True``
-   asserting an acyclic acquisition graph where every participating
-   thread is NAMED (the thread-lifecycle lint's payoff).
+   import, unknown knob, unnamed thread, un-keyed Settings read in a
+   traced body, unbound/dead collective axis, hot-path ``.item()``)
+   and the corrected version that must pass. An analyzer that
+   silently stopped finding anything would otherwise look exactly
+   like a clean tree. The capture pass additionally PROVES the
+   engine's cache-key totality over its four knob axes by deleting
+   each axis from a copy of the real engine source.
+3. The runtime halves: TracedLock cycle detection as a unit test plus
+   a chaos-marked e2e federation with ``Settings.LOCK_TRACING = True``
+   asserting an acyclic acquisition graph of NAMED threads, and
+   ``Settings.TRACE_CONTRACTS`` dispatch-time contract checks whose
+   mismatch witness names the offending knob on the real engine seam.
 """
 
 import pathlib
@@ -27,12 +33,15 @@ REPO = pathlib.Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))  # `tools` package import
 
 from tools.tpflcheck import (  # noqa: E402
+    check_capture,
     check_donate,
     check_events,
     check_guards,
     check_knobs,
     check_layers,
     check_locks,
+    check_spmd,
+    check_sync,
     check_threads,
     check_trace,
     run_all,
@@ -491,6 +500,391 @@ def test_events_fixture(tmp_path):
         tmp_path / "fam", {"tpfl/taps.py": fstring, **doc_ok}
     )
     assert check_events(root3) == []
+
+
+# --- capture: trace-capture totality (ISSUE 14) ---------------------------
+
+
+CAPTURE_BAD = """\
+    import jax
+    import jax.numpy as jnp
+
+    from tpfl.settings import Settings
+
+
+    @jax.jit
+    def scaled(x):
+        return x * Settings.WIRE_TOPK_FRAC
+"""
+
+CAPTURE_GOOD = """\
+    import jax
+    import jax.numpy as jnp
+
+    from tpfl.settings import Settings
+
+
+    @jax.jit
+    def scaled(x, frac):
+        return x * frac
+
+
+    def dispatch(x):
+        return scaled(x, Settings.WIRE_TOPK_FRAC)
+"""
+
+
+def test_capture_fixture_unkeyed_knob_read(tmp_path):
+    """A Settings read inside a jitted body bakes the knob into the
+    compiled program — must fail; hoisting it to a host-side argument
+    (or a '# trace-static:' annotation) passes."""
+    root = _mini_repo(tmp_path, {"tpfl/prog.py": CAPTURE_BAD})
+    found = check_capture(root)
+    assert any(
+        "WIRE_TOPK_FRAC" in v.message and "traced" in v.message
+        for v in found
+    ), [v.render() for v in found]
+    root2 = _mini_repo(tmp_path / "ok", {"tpfl/prog.py": CAPTURE_GOOD})
+    assert check_capture(root2) == []
+    annotated = CAPTURE_BAD.replace(
+        "        return x * Settings.WIRE_TOPK_FRAC",
+        "        # trace-static: pinned per experiment, never flipped\n"
+        "        return x * Settings.WIRE_TOPK_FRAC",
+    )
+    root3 = _mini_repo(tmp_path / "ann", {"tpfl/prog.py": annotated})
+    assert check_capture(root3) == []
+
+
+def test_capture_fixture_builder_closure(tmp_path):
+    """A Settings read inside a _build_* builder's nested program body
+    is a trace capture too (the engine/learner closure shape)."""
+    src = """\
+        import jax
+
+        from tpfl.settings import Settings
+
+
+        def _build_round(module):
+            def round_body(params, xs):
+                return params * Settings.WIRE_TOPK_FRAC
+
+            return jax.jit(round_body)
+    """
+    root = _mini_repo(tmp_path, {"tpfl/builder.py": src})
+    found = check_capture(root)
+    assert any("WIRE_TOPK_FRAC" in v.message for v in found), [
+        v.render() for v in found
+    ]
+
+
+GETTER_BAD = """\
+    import jax
+
+    _programs = {}
+
+
+    def program(kind, epochs, donate):
+        key = (kind, int(epochs))
+        fn = _programs.get(key)
+        if fn is None:
+            fn = _programs[key] = jax.jit(lambda x: x, donate_argnums=())
+        return fn
+"""
+
+GETTER_GOOD = GETTER_BAD.replace(
+    "    key = (kind, int(epochs))",
+    "    key = (kind, int(epochs), bool(donate))",
+)
+
+
+def test_capture_fixture_getter_key_totality(tmp_path):
+    """A cache getter whose key tuple misses one of its parameters is
+    one forgotten axis — exactly the stale-program bug class."""
+    # engine.py is in the capture pass's CACHE_MODULES roster.
+    root = _mini_repo(tmp_path, {"tpfl/parallel/engine.py": GETTER_BAD})
+    found = check_capture(root)
+    keys = {v.key for v in found}
+    assert "capture:tpfl/parallel/engine.py::program::donate" in keys, [
+        v.render() for v in found
+    ]
+    root2 = _mini_repo(
+        tmp_path / "ok", {"tpfl/parallel/engine.py": GETTER_GOOD}
+    )
+    assert check_capture(root2) == []
+
+
+ENGINE_KEY_AXES = (
+    # (fragment to delete from the real engine source, flagged param)
+    ("bool(donate),\n", "donate"),
+    ("bool(telemetry), ", "telemetry"),
+    ("int(codec), ", "codec"),
+    ("float(topk_frac),", "topk_frac"),
+)
+
+
+def test_capture_proves_engine_key_totality(tmp_path):
+    """Acceptance: the engine's cache-key totality over
+    ENGINE_TELEMETRY/ENGINE_WIRE_CODEC/WIRE_TOPK_FRAC/ENGINE_DONATE is
+    PROVEN by the capture pass — deleting any one key axis from the
+    real engine source makes the suite fail, naming the lost axis."""
+    src = (REPO / "tpfl" / "parallel" / "engine.py").read_text()
+    target = tmp_path / "tpfl" / "parallel" / "engine.py"
+    target.parent.mkdir(parents=True)
+    target.write_text(src)
+    assert check_capture(tmp_path) == []  # the real engine is clean
+    for fragment, param in ENGINE_KEY_AXES:
+        assert fragment in src, fragment
+        target.write_text(src.replace(fragment, "", 1))
+        found = check_capture(tmp_path)
+        assert any(v.key.endswith(f"::{param}") for v in found), (
+            f"deleting {fragment!r} from the program-cache key was NOT "
+            f"caught: {[v.render() for v in found]}"
+        )
+
+
+def test_capture_proves_engine_knob_flow(tmp_path):
+    """Dispatch side of the same proof: a run_rounds that resolves
+    ENGINE_TELEMETRY but stops threading it into the program getter is
+    flagged — the live knob could no longer select the variant."""
+    src = (REPO / "tpfl" / "parallel" / "engine.py").read_text()
+    target = tmp_path / "tpfl" / "parallel" / "engine.py"
+    target.parent.mkdir(parents=True)
+    frag = "kind, epochs, n_rounds, w.ndim, donate, tele_on, a_ndim,"
+    assert frag in src
+    target.write_text(
+        src.replace(
+            frag,
+            "kind, epochs, n_rounds, w.ndim, donate, False, a_ndim,",
+            1,
+        )
+    )
+    found = check_capture(tmp_path)
+    assert any(v.key.endswith("::tele_on") for v in found), [
+        v.render() for v in found
+    ]
+
+
+# --- spmd: collective/axis lint (ISSUE 14) --------------------------------
+
+
+SPMD_BAD = """\
+    import jax
+    from jax import lax
+    from jax.sharding import PartitionSpec
+    from tpfl.parallel.compat import shard_map
+
+
+    def inner(x):
+        i = jax.lax.axis_index("nodes")
+        return lax.psum(x, "nodes")
+
+
+    def outer(mesh, x):
+        fn = shard_map(inner, mesh=mesh, in_specs=(PartitionSpec("ring"),),
+                       out_specs=PartitionSpec("ring"))
+        return fn(x)
+"""
+
+SPMD_GOOD = """\
+    import jax
+    from jax import lax
+    from jax.sharding import PartitionSpec
+    from tpfl.parallel.compat import shard_map
+
+
+    def inner(x):
+        i = jax.lax.axis_index("nodes")
+        return lax.psum(x * i, "nodes")
+
+
+    def outer(mesh, x):
+        fn = shard_map(inner, mesh=mesh, in_specs=(PartitionSpec("nodes"),),
+                       out_specs=PartitionSpec("nodes"))
+        return fn(x)
+"""
+
+
+def test_spmd_fixture_unbound_axis_and_dead_axis_index(tmp_path):
+    """The PR-10 bug class, seeded: an axis name no enclosing binding
+    declares, and an axis_index whose result nothing consumes."""
+    root = _mini_repo(tmp_path, {"tpfl/ring.py": SPMD_BAD})
+    found = check_spmd(root)
+    keys = {v.key for v in found}
+    # the dead axis_index (consumed by nothing) ...
+    assert "spmd:tpfl/ring.py:8:dead" in keys, [v.render() for v in found]
+    # ... and both collectives name an axis bound nowhere ("ring" is
+    # what the enclosing shard_map actually binds).
+    assert any("never consumed" in v.message for v in found)
+    assert any("no enclosing shard_map" in v.message for v in found)
+    root2 = _mini_repo(tmp_path / "ok", {"tpfl/ring.py": SPMD_GOOD})
+    assert check_spmd(root2) == []
+
+
+def test_spmd_fixture_axis_generic_helper(tmp_path):
+    """An axis-generic helper (axis as parameter) is clean by itself;
+    the obligation transfers to its resolvable call sites."""
+    src = """\
+        import jax
+        from jax import lax
+        from jax.sharding import PartitionSpec
+        from tpfl.parallel.compat import shard_map
+
+
+        def helper(x, axis_name):
+            return lax.psum(x, axis_name)
+
+
+        def good(mesh, x):
+            spec = PartitionSpec("sp")
+            fn = shard_map(lambda y: helper(y, "sp"), mesh=mesh,
+                           in_specs=(spec,), out_specs=spec)
+            return fn(x)
+    """
+    root = _mini_repo(tmp_path, {"tpfl/helper.py": src})
+    assert check_spmd(root) == [], [v.render() for v in check_spmd(root)]
+    bad = src.replace('helper(y, "sp")', 'helper(y, "other")')
+    root2 = _mini_repo(tmp_path / "bad", {"tpfl/helper.py": bad})
+    found = check_spmd(root2)
+    assert found and "no enclosing shard_map" in found[0].message, [
+        v.render() for v in found
+    ]
+
+
+# --- sync: host-sync lint (ISSUE 14) --------------------------------------
+
+
+SYNC_BAD = """\
+    import jax
+
+
+    def drive(fn, args):
+        out = fn(*args)
+        total = float(out)
+        return out.item() + total
+"""
+
+SYNC_GOOD = """\
+    import jax
+
+    from tpfl.settings import Settings
+
+
+    def drive(fn, args, prof):
+        out = fn(*args)
+        if prof:
+            jax.block_until_ready(out)
+        # host-sync: window close — the result is consumed on host here
+        total = float(out)
+        return total
+"""
+
+
+def test_sync_fixture_hot_path_item(tmp_path):
+    """.item() / float() of a compiled-program result in a hot-path
+    module fails; profiling-gated and '# host-sync:'-annotated syncs
+    pass."""
+    root = _mini_repo(tmp_path, {"tpfl/parallel/engine.py": SYNC_BAD})
+    found = check_sync(root)
+    msgs = [v.message for v in found]
+    assert any(".item()" in m for m in msgs), [v.render() for v in found]
+    assert any("float()" in m for m in msgs)
+    root2 = _mini_repo(tmp_path / "ok", {"tpfl/parallel/engine.py": SYNC_GOOD})
+    assert check_sync(root2) == [], [v.render() for v in check_sync(root2)]
+
+
+def test_sync_fixture_np_asarray_of_device_value(tmp_path):
+    src = """\
+        import numpy as np
+
+
+        def fold(losses):
+            host = np.asarray(losses)
+            return host.sum()
+    """
+    root = _mini_repo(tmp_path, {"tpfl/simulation/batched_fit.py": src})
+    found = check_sync(root)
+    assert any("np.asarray" in v.message for v in found), [
+        v.render() for v in found
+    ]
+    # Non-hot-path modules are out of scope by design.
+    root2 = _mini_repo(tmp_path / "cold", {"tpfl/utils.py": src})
+    assert check_sync(root2) == []
+
+
+# --- runtime: TRACE_CONTRACTS dispatch witness (ISSUE 14) -----------------
+
+
+@pytest.fixture
+def _trace_contracts():
+    snap = Settings.snapshot()
+    Settings.set_test_settings()
+    Settings.TRACE_CONTRACTS = True
+    yield
+    Settings.restore(snap)
+
+
+def test_check_contract_unit(_trace_contracts):
+    from tpfl.concurrency import (
+        TraceContractError,
+        check_contract,
+        stamp_contract,
+    )
+
+    calls = []
+    fn = stamp_contract(lambda *a: calls.append(a) or "out", {"K": 1})
+    assert fn(3) == "out" and calls == [(3,)]  # transparent callable
+    check_contract(fn, {"K": 1})  # matching values pass
+    check_contract(fn, {"OTHER": 9})  # unrelated knobs ignored
+    with pytest.raises(TraceContractError) as exc:
+        check_contract(fn, {"K": 2})
+    msg = str(exc.value)
+    assert "K" in msg and "1" in msg and "2" in msg  # named witness
+    # Unstamped callables (contracts off at build time) pass silently.
+    check_contract(lambda: None, {"K": 5})
+
+
+def test_contract_stamp_is_off_by_default():
+    from tpfl.concurrency import stamp_contract
+
+    snap = Settings.snapshot()
+    try:
+        Settings.TRACE_CONTRACTS = False
+
+        def fn():
+            return 1
+
+        assert stamp_contract(fn, {"K": 1}) is fn  # zero wrappers off
+    finally:
+        Settings.restore(snap)
+
+
+def test_trace_contracts_engine_dispatch_witness(_trace_contracts):
+    """The dispatch-time mismatch witness fires on the REAL engine
+    seam and names the offending knob: simulate a cache key that lost
+    its ENGINE_DONATE axis (two donation variants colliding on one
+    slot) and dispatch under the other knob value."""
+    import jax.numpy as jnp
+
+    from tpfl.concurrency import TraceContractError
+    from tpfl.models import create_model
+    from tpfl.parallel.engine import FederationEngine
+
+    module = create_model("mlp", (4,), seed=0, hidden_sizes=(8,)).module
+    eng = FederationEngine(module, 2, learning_rate=0.1, seed=0)
+    params = eng.init_params((4,))
+    xs = jnp.zeros((2, 1, 4, 4))
+    ys = jnp.zeros((2, 1, 4), jnp.int32)
+    out = eng.run_rounds(params, xs, ys, epochs=1, donate=False)
+    frac = float(Settings.WIRE_TOPK_FRAC)
+    key_false = ("plain", 1, 1, 1, False, False, 0, 0, frac)
+    key_true = ("plain", 1, 1, 1, True, False, 0, 0, frac)
+    assert key_false in eng._wrapped
+    # The seeded key-hygiene bug: the donate=True slot serves the
+    # donate=False-compiled program.
+    eng._wrapped[key_true] = eng._wrapped[key_false]
+    with pytest.raises(TraceContractError) as exc:
+        eng.run_rounds(out[0], xs, ys, epochs=1, donate=True)
+    assert "ENGINE_DONATE" in str(exc.value)
 
 
 # --- 3. runtime: TracedLock + traced chaos federation --------------------

@@ -502,14 +502,12 @@ def test_wirecheck_rpc_lint_passes():
     import pathlib
     import sys
 
-    tools = pathlib.Path(__file__).resolve().parent.parent / "tools"
-    sys.path.insert(0, str(tools))
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
     try:
-        import wirecheck
-
-        assert wirecheck.check_rpc() == []
+        from tools.tpflcheck.wire import check_rpc
     finally:
-        sys.path.remove(str(tools))
+        sys.path.pop(0)
+    assert check_rpc() == []
 
 
 def test_fault_injector_is_deterministic():

@@ -346,14 +346,12 @@ def test_wirecheck_lint_passes():
     import pathlib
     import sys
 
-    tools = pathlib.Path(__file__).resolve().parent.parent / "tools"
-    sys.path.insert(0, str(tools))
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
     try:
-        import wirecheck
-
-        assert wirecheck.check() == []
+        from tools.tpflcheck.wire import check
     finally:
-        sys.path.remove(str(tools))
+        sys.path.pop(0)
+    assert check() == []
 
 
 # --- e2e: two gRPC nodes exchanging quantized deltas over chunks ---

@@ -184,14 +184,12 @@ def test_wirecheck_copy_lint_passes():
     import pathlib
     import sys
 
-    sys.path.insert(
-        0, str(pathlib.Path(__file__).resolve().parent.parent / "tools")
-    )
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
     try:
-        import wirecheck
+        from tools.tpflcheck.wire import check_copies
     finally:
         sys.path.pop(0)
-    assert wirecheck.check_copies() == [], wirecheck.check_copies()
+    assert check_copies() == [], check_copies()
 
 
 # --- full-federation e2e under zero-copy + eager streaming ---

@@ -1,11 +1,23 @@
 """tpflcheck — tpfl's static concurrency & invariant analysis suite.
 
-Grown out of ``tools/wirecheck.py`` (which remains as a shim): one
-framework, shared file-walking / waiver / reporting machinery
-(``core.py``), seven checks::
+Grown out of ``tools/wirecheck.py`` (now retired): one framework,
+shared file-walking / waiver / reporting machinery (``core.py``),
+twelve checks::
 
     guards    guarded-by race lint (# guarded-by: annotations)
     locks     static lock-order extraction + deadlock (cycle) detection
+    capture   trace-capture totality (a Settings knob a traced program
+              body reads must be an axis of its cache key, cache-getter
+              key tuples must be total over their parameters, and
+              dispatch-resolved knobs must reach the key — the stale-
+              compiled-program bug class; runtime half:
+              Settings.TRACE_CONTRACTS)
+    spmd      SPMD collective/axis lint (psum/all_gather/axis_index
+              axis names must be bound by an enclosing shard_map/vmap/
+              pmap; a dead axis_index is the PR-10 partitioner bug)
+    sync      host-sync lint (.item(), float()/np.asarray of device
+              values, bare block_until_ready on hot-path modules must
+              be observability-gated or '# host-sync:' annotated)
     donate    donated-buffer reuse lint (a jax.jit donate_argnums
               binding must not be read after the dispatch that
               consumed it — re-bind from the program's outputs)
@@ -40,24 +52,30 @@ from tools.tpflcheck.core import (
     load_waivers,
     repo_root,
 )
+from tools.tpflcheck.capture import check_capture
 from tools.tpflcheck.donate import check_donate
 from tools.tpflcheck.events import check_events
 from tools.tpflcheck.guards import check_guards
 from tools.tpflcheck.knobs import check_knobs
 from tools.tpflcheck.layers import check_layers
 from tools.tpflcheck.locks import check_locks, lock_edges
+from tools.tpflcheck.spmd import check_spmd
+from tools.tpflcheck.sync import check_sync
 from tools.tpflcheck.threads import check_threads
 from tools.tpflcheck.trace import check_trace
 
 __all__ = [
     "Violation",
     "Waivers",
+    "check_capture",
     "check_donate",
     "check_events",
     "check_guards",
     "check_knobs",
     "check_layers",
     "check_locks",
+    "check_spmd",
+    "check_sync",
     "check_threads",
     "check_trace",
     "lock_edges",
@@ -82,6 +100,9 @@ def run_all(
     violations += check_trace(root)
     violations += check_events(root)
     violations += check_donate(root)
+    violations += check_capture(root)
+    violations += check_spmd(root)
+    violations += check_sync(root)
     violations += wire.violations(root)
 
     waivers = load_waivers(root)

@@ -1,0 +1,581 @@
+"""Trace-capture totality lint: what a compiled program captures must
+be an axis of its cache key.
+
+JAX traces a Python callable ONCE per cache key and replays the
+compiled XLA program forever after. Anything the traced body reads
+from ambient Python state — a ``Settings.<KNOB>``, a module global —
+is baked into the program as a constant at trace time. If that value
+is not an axis of the cache key the program is stored under, flipping
+the knob later silently serves a STALE program: no error, no recompile,
+just last month's semantics. This is the repo's worst recurring bug
+class (the PR-13 cache keys over ``ENGINE_TELEMETRY`` /
+``ENGINE_WIRE_CODEC`` / ``WIRE_TOPK_FRAC`` / ``ENGINE_DONATE`` were
+kept total by reviewer discipline alone); this pass makes it a
+machine-checked contract.
+
+Three rules over ``tpfl/``:
+
+1. **Trace purity** — no ``Settings.<KNOB>`` read inside a traced
+   region. Traced regions are: functions jitted directly
+   (``@jax.jit`` / ``@partial(jax.jit, ...)`` decorations, ``jax.jit(f)``
+   on a module/local function), every function nested inside a program
+   BUILDER (a ``_build_*`` / ``_make_*`` / ``build_*`` / ``make_*``
+   function in a jax-importing module — the nested defs ARE the traced
+   program body), and — one level deep, like ``locks.py`` — any
+   same-module function or ``self.`` method a traced region calls.
+   Knob values must enter as builder arguments (key axes) or traced
+   inputs. Escape hatch: ``# trace-static: <reason>`` on the read's
+   line (or the comment block above) for values that are genuinely
+   trace-constant by design.
+
+2. **Key totality** (getter side) — in any function that builds a
+   cache key (``key = (<tuple>)``) and uses it against a program cache
+   (``cache.get(key)`` / ``cache[key]``, or ``key`` handed to a shared
+   lookup helper), every non-self parameter must appear inside the key
+   tuple — a parameter that selects or parameterizes the build but is
+   missing from the key is exactly one forgotten axis. Parameters that
+   are runtime INPUTS (passed to the cache-fetched callable when it is
+   invoked in the same scope) are exempt. Free local names captured by
+   a builder lambda/closure handed along with the key must appear in
+   the key too (the ``_shared_program`` discipline).
+
+3. **Knob→key flow** (dispatch side) — in a function that resolves
+   Settings knobs into locals (directly, or by tuple-unpacking a
+   same-class helper that reads Settings — ``_resolve_variant``) AND
+   calls a key-building getter from rule 2, every knob-derived local
+   must appear among some getter call's arguments. A resolved knob
+   that never reaches the key means dispatch ignores the live value.
+
+Waiver keys: ``capture:<file>::<qualname>::<name>`` (rule 2/3) and
+``capture:<file>:<line>`` (rule 1). The runtime complement is
+``Settings.TRACE_CONTRACTS`` (``tpfl.concurrency.check_contract``):
+the engine stamps every cached program with the knob values its key
+was built from and re-checks them live at dispatch, so a key-hygiene
+bug that slips past the static pass fails loudly with a named witness
+instead of silently serving stale semantics.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+
+from tools.tpflcheck.core import Violation, py_files, rel, repo_root
+
+_BUILDER_RE = re.compile(r"^_?(?:build|make)_")
+_ANNOT_RE = re.compile(r"#\s*trace-static:\s*(\S.*)$")
+
+#: Modules whose builders are host-side object factories, not program
+#: builders (no jax import => no traced regions).
+_JAX_MODULES_HINT = ("jax", "jnp", "lax", "optax", "flax")
+
+#: The program-cache seams (rules 2/3): modules whose ``key = (...)``
+#: + cache-lookup functions select COMPILED PROGRAMS. Other keyed
+#: stores (metric registries, model caches) key data, not traces —
+#: a missing axis there is a logic bug, not a stale program.
+CACHE_MODULES = (
+    "tpfl/parallel/engine.py",
+    "tpfl/parallel/federation.py",
+    "tpfl/parallel/federation_learner.py",
+    "tpfl/parallel/sharded.py",
+    "tpfl/learning/jax_learner.py",
+    "tpfl/learning/compression.py",
+    "tpfl/simulation/batched_fit.py",
+)
+
+
+def _annotated(lines: list[str], lineno: int) -> bool:
+    """``# trace-static: <reason>`` on the line or the contiguous
+    comment block directly above (guards.py's annotation discipline)."""
+    candidates = [lines[lineno - 1]]
+    i = lineno - 2
+    while i >= 0 and lines[i].strip().startswith("#"):
+        candidates.append(lines[i])
+        i -= 1
+    return any(_ANNOT_RE.search(text) for text in candidates)
+
+
+def _imports_jax(tree: ast.Module) -> bool:
+    for node in tree.body:
+        if isinstance(node, ast.Import):
+            if any(a.name.split(".")[0] in _JAX_MODULES_HINT for a in node.names):
+                return True
+        elif isinstance(node, ast.ImportFrom):
+            if node.module and node.module.split(".")[0] in _JAX_MODULES_HINT:
+                return True
+    return False
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    if isinstance(node, ast.Attribute) and node.attr == "jit":
+        return isinstance(node.value, ast.Name) and node.value.id == "jax"
+    return isinstance(node, ast.Name) and node.id == "jit"
+
+
+def _jit_decorated(fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", []):
+        if _is_jax_jit(dec):
+            return True
+        if isinstance(dec, ast.Call):
+            if _is_jax_jit(dec.func):
+                return True
+            if (
+                isinstance(dec.func, ast.Name)
+                and dec.func.id == "partial"
+                and dec.args
+                and _is_jax_jit(dec.args[0])
+            ):
+                return True
+    return False
+
+
+def _settings_reads(node: ast.AST) -> "list[tuple[str, int]]":
+    """(knob, line) for every ``Settings.<KNOB>`` read under ``node``."""
+    out = []
+    for sub in ast.walk(node):
+        if (
+            isinstance(sub, ast.Attribute)
+            and isinstance(sub.value, ast.Name)
+            and sub.value.id == "Settings"
+            and sub.attr.isupper()
+        ):
+            out.append((sub.attr, sub.lineno))
+    return out
+
+
+class _FunctionIndex:
+    """Same-module function/method defs for one-level call resolution."""
+
+    def __init__(self, tree: ast.Module) -> None:
+        self.module_fns: dict[str, ast.AST] = {}
+        self.methods: dict[tuple[str, str], ast.AST] = {}
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.module_fns[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        self.methods[(node.name, sub.name)] = sub
+
+    def resolve(self, call: ast.Call, cls: "str | None") -> "ast.AST | None":
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            return self.module_fns.get(fn.id)
+        if (
+            isinstance(fn, ast.Attribute)
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id in ("self", "cls")
+            and cls is not None
+        ):
+            return self.methods.get((cls, fn.attr))
+        return None
+
+
+def _traced_roots(tree: ast.Module) -> "list[ast.AST]":
+    """Function nodes whose bodies run under trace: directly-jitted
+    defs/lambdas, and every def nested inside a program builder."""
+    roots: list[ast.AST] = []
+    index = _FunctionIndex(tree)
+    jitted_names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if _jit_decorated(node):
+                roots.append(node)
+            elif _BUILDER_RE.match(node.name):
+                # The builder's nested defs are the program body; the
+                # builder's own top level is host code (it runs once,
+                # at build time — but anything it bakes into the
+                # closure the nested defs read IS part of the trace).
+                for sub in ast.walk(node):
+                    if sub is not node and isinstance(
+                        sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+                    ):
+                        roots.append(sub)
+        elif isinstance(node, ast.Call) and _is_jax_jit(node.func):
+            if node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Lambda):
+                    roots.append(arg)
+                elif isinstance(arg, ast.Name):
+                    jitted_names.add(arg.id)
+    for name in jitted_names:
+        fn = index.module_fns.get(name)
+        if fn is not None:
+            roots.append(fn)
+    return roots
+
+
+def _check_purity(
+    r: str, tree: ast.Module, lines: list[str]
+) -> list[Violation]:
+    index = _FunctionIndex(tree)
+    # Map every function node to its enclosing class for self-resolution.
+    enclosing_cls: dict[ast.AST, "str | None"] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            for sub in ast.walk(node):
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    enclosing_cls[sub] = node.name
+
+    seen: set[int] = set()
+    worklist: list[tuple[ast.AST, int]] = [(n, 0) for n in _traced_roots(tree)]
+    violations: list[Violation] = []
+    while worklist:
+        fn, depth = worklist.pop()
+        if id(fn) in seen:
+            continue
+        seen.add(id(fn))
+        for knob, lineno in _settings_reads(fn):
+            if _annotated(lines, lineno):
+                continue
+            violations.append(
+                Violation(
+                    "capture", r, lineno,
+                    f"Settings.{knob} read inside a traced program body — "
+                    "the value is baked in at trace time and a later knob "
+                    "flip silently serves a stale compiled program; pass "
+                    "it in as a cache-key axis / traced input, or annotate "
+                    "'# trace-static: <reason>'",
+                    f"capture:{r}:{lineno}",
+                )
+            )
+        if depth >= 1:
+            continue  # one level of call resolution, like locks.py
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Call):
+                callee = index.resolve(sub, enclosing_cls.get(fn))
+                if callee is not None:
+                    worklist.append((callee, depth + 1))
+    # Dedupe (a nested def reachable from two roots reports once).
+    uniq: dict[tuple[str, int], Violation] = {}
+    for v in violations:
+        uniq.setdefault((v.key, v.line), v)
+    return list(uniq.values())
+
+
+# --- rule 2/3: cache-key totality and knob→key flow ----------------------
+
+
+def _names_in(node: ast.AST) -> set[str]:
+    return {
+        n.id for n in ast.walk(node) if isinstance(n, ast.Name)
+    }
+
+
+class _Getter:
+    """A key-building cache-getter function: where its key tuple is,
+    which params it has, and which it keys / feeds to the cached fn."""
+
+    def __init__(self, fn: ast.AST, cls: "str | None") -> None:
+        self.fn = fn
+        self.cls = cls
+        self.name = fn.name
+        args = fn.args
+        self.params = [
+            a.arg
+            for a in (args.posonlyargs + args.args + args.kwonlyargs)
+            if a.arg not in ("self", "cls")
+        ]
+        self.key_tuple: "ast.Tuple | None" = None
+        self.key_line = fn.lineno
+        self.cache_hit = False  # key used against a dict / passed on
+        self.fetched_names: set[str] = set()  # locals bound from cache
+        self._scan()
+
+    def _scan(self) -> None:
+        for node in ast.walk(self.fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt, val = node.targets[0], node.value
+                if (
+                    isinstance(tgt, ast.Name)
+                    and tgt.id == "key"
+                    and isinstance(val, ast.Tuple)
+                ):
+                    self.key_tuple = val
+                    self.key_line = node.lineno
+                # fn = cache.get(key) / fn = cache[key] / chained assign
+                if isinstance(tgt, ast.Name) and _uses_key(val):
+                    self.fetched_names.add(tgt.id)
+            if isinstance(node, ast.Call):
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "get"
+                    and any(
+                        isinstance(a, ast.Name) and a.id == "key"
+                        for a in node.args
+                    )
+                ):
+                    self.cache_hit = True
+                elif any(
+                    isinstance(a, ast.Name) and a.id == "key"
+                    for a in node.args
+                ):
+                    self.cache_hit = True  # key handed to a lookup helper
+            if isinstance(node, ast.Subscript):
+                sl = node.slice
+                if isinstance(sl, ast.Name) and sl.id == "key":
+                    self.cache_hit = True
+
+    @property
+    def is_getter(self) -> bool:
+        return self.key_tuple is not None and self.cache_hit
+
+    def runtime_input_names(self) -> set[str]:
+        """Names passed to the cache-fetched callable when invoked in
+        this scope — runtime inputs, not key axes."""
+        out: set[str] = set()
+        for node in ast.walk(self.fn):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in self.fetched_names
+            ):
+                for a in list(node.args) + [k.value for k in node.keywords]:
+                    out |= _names_in(a)
+        return out
+
+    def closure_arg_names(self) -> "list[tuple[set[str], int]]":
+        """Free names of lambdas/defs passed alongside ``key`` in a
+        call (the ``_shared_program(key, lambda: ...)`` shape)."""
+        out: list[tuple[set[str], int]] = []
+        for node in ast.walk(self.fn):
+            if not isinstance(node, ast.Call):
+                continue
+            has_key = any(
+                isinstance(a, ast.Name) and a.id == "key" for a in node.args
+            )
+            if not has_key:
+                continue
+            for a in node.args:
+                if isinstance(a, ast.Lambda):
+                    out.append((_names_in(a.body), a.lineno))
+        return out
+
+
+def _uses_key(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Subscript):
+            if isinstance(sub.slice, ast.Name) and sub.slice.id == "key":
+                return True
+        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Attribute):
+            if sub.func.attr == "get" and any(
+                isinstance(a, ast.Name) and a.id == "key" for a in sub.args
+            ):
+                return True
+    return False
+
+
+def _collect_getters(tree: ast.Module) -> "list[_Getter]":
+    getters: list[_Getter] = []
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            g = _Getter(node, None)
+            if g.is_getter or g.key_tuple is not None:
+                getters.append(g)
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    g = _Getter(sub, node.name)
+                    if g.is_getter or g.key_tuple is not None:
+                        getters.append(g)
+    return getters
+
+
+def _check_key_totality(
+    r: str, getters: "list[_Getter]", lines: list[str]
+) -> list[Violation]:
+    violations: list[Violation] = []
+    for g in getters:
+        if g.key_tuple is None:
+            continue
+        key_names = _names_in(g.key_tuple)
+        runtime = g.runtime_input_names() if g.is_getter else set()
+        qual = f"{g.cls}.{g.name}" if g.cls else g.name
+        if g.is_getter:
+            for p in g.params:
+                if p in key_names or p in runtime:
+                    continue
+                if _annotated(lines, g.key_line):
+                    continue
+                violations.append(
+                    Violation(
+                        "capture", r, g.key_line,
+                        f"parameter `{p}` of cache getter {qual}() is not "
+                        "an axis of its program-cache key — a variant it "
+                        "selects will silently collide with another "
+                        "variant's compiled program; add it to the key "
+                        "tuple (or annotate '# trace-static: <reason>' "
+                        "on the key line)",
+                        f"capture:{r}::{qual}::{p}",
+                    )
+                )
+        # Closure-capture totality: _shared_program(key, lambda: ...)
+        for free, lineno in g.closure_arg_names():
+            local_free = free & _local_bindings(g.fn)
+            for name in sorted(local_free - key_names):
+                if _annotated(lines, lineno):
+                    continue
+                violations.append(
+                    Violation(
+                        "capture", r, lineno,
+                        f"builder closure in {qual}() captures local "
+                        f"`{name}` which is not an axis of the cache key "
+                        "it is stored under — two configs differing only "
+                        f"in `{name}` would share one compiled program",
+                        f"capture:{r}::{qual}::{name}",
+                    )
+                )
+    return violations
+
+
+def _local_bindings(fn: ast.AST) -> set[str]:
+    """Parameter and assigned-local names of ``fn`` (its own scope
+    only — nested defs are their own scope)."""
+    args = fn.args
+    out = {
+        a.arg
+        for a in (args.posonlyargs + args.args + args.kwonlyargs)
+        if a.arg not in ("self", "cls")
+    }
+
+    def visit(node: ast.AST, top: bool = False) -> None:
+        if not top and isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            return
+        if isinstance(node, ast.Name) and isinstance(
+            node.ctx, (ast.Store,)
+        ):
+            out.add(node.id)
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    visit(fn, top=True)
+    return out
+
+
+def _check_knob_flow(
+    r: str,
+    tree: ast.Module,
+    getters: "list[_Getter]",
+    lines: list[str],
+) -> list[Violation]:
+    """Rule 3: Settings-derived locals must reach a getter's args."""
+    # Only getters with keyed parameters can receive a knob axis —
+    # a zero-arg builder (`_build_train_epoch`) takes no variant
+    # selectors, so dispatching through it creates no flow obligation.
+    strict_getter_names = {
+        (g.cls, g.name) for g in getters if g.is_getter and g.params
+    }
+    if not strict_getter_names:
+        return []
+    # Same-class helpers whose bodies read Settings (one level): their
+    # call results count as knob-derived ("_resolve_variant").
+    knob_helpers: dict[tuple["str | None", str], list[str]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    reads = [k for k, _ in _settings_reads(sub)]
+                    if reads:
+                        knob_helpers[(node.name, sub.name)] = reads
+
+    violations: list[Violation] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for fn in node.body:
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            # knob-derived locals: name -> (knob(s), line)
+            derived: dict[str, tuple[str, int]] = {}
+            for stmt in ast.walk(fn):
+                if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1:
+                    continue
+                tgt, val = stmt.targets[0], stmt.value
+                reads = [k for k, _ in _settings_reads(val)]
+                if (
+                    not reads
+                    and isinstance(val, ast.Call)
+                    and isinstance(val.func, ast.Attribute)
+                    and isinstance(val.func.value, ast.Name)
+                    and val.func.value.id in ("self", "cls")
+                ):
+                    reads = knob_helpers.get(
+                        (node.name, val.func.attr), []
+                    )
+                if not reads:
+                    continue
+                label = "/".join(sorted(set(reads)))
+                if isinstance(tgt, ast.Name):
+                    derived[tgt.id] = (label, stmt.lineno)
+                elif isinstance(tgt, ast.Tuple):
+                    for elt in tgt.elts:
+                        if isinstance(elt, ast.Name):
+                            derived[elt.id] = (label, stmt.lineno)
+            if not derived:
+                continue
+            # getter calls in this fn (self.<getter> / bare <getter>)
+            getter_arg_names: set[str] = set()
+            calls_getter = False
+            for call in ast.walk(fn):
+                if not isinstance(call, ast.Call):
+                    continue
+                f = call.func
+                name = None
+                if isinstance(f, ast.Name):
+                    name = f.id
+                elif isinstance(f, ast.Attribute) and isinstance(
+                    f.value, ast.Name
+                ) and f.value.id in ("self", "cls"):
+                    name = f.attr
+                if name is None:
+                    continue
+                if any(n == name for _c, n in strict_getter_names):
+                    calls_getter = True
+                    for a in list(call.args) + [
+                        k.value for k in call.keywords
+                    ]:
+                        getter_arg_names |= _names_in(a)
+            if not calls_getter:
+                continue
+            qual = f"{node.name}.{fn.name}"
+            for name, (label, lineno) in sorted(derived.items()):
+                if name in getter_arg_names:
+                    continue
+                if _annotated(lines, lineno):
+                    continue
+                violations.append(
+                    Violation(
+                        "capture", r, lineno,
+                        f"{qual}() resolves Settings ({label}) into "
+                        f"`{name}` but never passes it to the program "
+                        "cache getter it dispatches through — the live "
+                        "knob value cannot select the program variant; "
+                        "thread it into the key (or annotate "
+                        "'# trace-static: <reason>')",
+                        f"capture:{r}::{qual}::{name}",
+                    )
+                )
+    return violations
+
+
+def check_capture(repo: "pathlib.Path | None" = None) -> list[Violation]:
+    root = repo_root(repo)
+    violations: list[Violation] = []
+    for path in py_files(root):
+        r = rel(root, path)
+        try:
+            src = path.read_text(encoding="utf-8")
+            tree = ast.parse(src)
+        except SyntaxError:
+            continue
+        lines = src.splitlines()
+        if _imports_jax(tree):
+            violations += _check_purity(r, tree, lines)
+        if r in CACHE_MODULES:
+            getters = _collect_getters(tree)
+            violations += _check_key_totality(r, getters, lines)
+            violations += _check_knob_flow(r, tree, getters, lines)
+    return violations

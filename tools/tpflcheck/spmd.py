@@ -1,0 +1,535 @@
+"""SPMD collective/axis lint.
+
+Two JAX-semantics invariants over ``tpfl/``:
+
+1. **Axis binding** — every named-axis collective
+   (``lax.psum`` / ``pmean`` / ``psum_scatter`` / ``all_gather`` /
+   ``all_to_all`` / ``ppermute`` / ``axis_index`` / …) must name an
+   axis that is BOUND by an enclosing ``shard_map`` / ``vmap`` /
+   ``pmap`` in the same statically-visible scope. An unbound axis name
+   is an eager ``NameError`` only on the paths a test actually runs —
+   on the untested variant it is a latent crash. Resolution:
+
+   - string literals and module-level string constants resolve
+     directly (one import hop: ``NODE_AXIS`` from
+     ``tpfl.parallel.mesh``);
+   - an axis that is a function PARAMETER is fine locally ("runs
+     inside the caller's shard_map" — the inner-fn contract); the
+     obligation transfers to statically-resolvable call sites
+     (one-level resolution like ``locks.py``: bare same-module calls,
+     ``self.`` methods, and ``partial(fn, axis_name=...)``), walked up
+     until a scope either binds the axis or passes its own parameter
+     outward (a public inner API — callers outside the repo bind it);
+   - a scope "binds" an axis when the axis name (or the constant that
+     resolves to it) appears in a ``PartitionSpec(...)``, an
+     ``axis_name=`` / ``axis_names=`` keyword, or a mesh axis dict in
+     the same outermost function (or at module level).
+
+2. **Dead axis_index** — a ``lax.axis_index(...)`` whose result is
+   never consumed is an error, not dead weight: XLA's sharding
+   propagation flows from USERS, so a user-less ``axis_index`` inside
+   a custom-call jaxpr never receives the ``{manual}`` sharding and
+   the SPMD partitioner rejects the whole program — the exact
+   dead-``axis_index`` lowering that broke the flash ring's
+   partitioning (fixed in PR 10). The result must be assigned to a
+   name that is later read (anywhere in the enclosing function,
+   nested closures included) or used directly in an expression.
+
+Waiver keys: ``spmd:<file>:<line>`` (unbound axis) and
+``spmd:<file>:<line>:dead`` (dead axis_index).
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+
+from tools.tpflcheck.core import Violation, py_files, rel, repo_root
+
+#: collective -> positional index of its axis-name argument.
+COLLECTIVES = {
+    "psum": 1,
+    "pmean": 1,
+    "pmax": 1,
+    "pmin": 1,
+    "psum_scatter": 1,
+    "all_gather": 1,
+    "all_to_all": 1,
+    "ppermute": 1,
+    "pshuffle": 1,
+    "axis_index": 0,
+    "axis_size": 0,
+}
+
+_BINDING_CALLS = ("shard_map", "vmap", "pmap", "xmap")
+
+
+def _collective_name(call: ast.Call) -> "str | None":
+    """'psum' for ``lax.psum`` / ``jax.lax.psum`` / bare ``psum``."""
+    fn = call.func
+    if isinstance(fn, ast.Name) and fn.id in COLLECTIVES:
+        return fn.id
+    if isinstance(fn, ast.Attribute) and fn.attr in COLLECTIVES:
+        base = fn.value
+        if isinstance(base, ast.Name) and base.id == "lax":
+            return fn.attr
+        if (
+            isinstance(base, ast.Attribute)
+            and base.attr == "lax"
+            and isinstance(base.value, ast.Name)
+            and base.value.id == "jax"
+        ):
+            return fn.attr
+    return None
+
+
+def _axis_expr(call: ast.Call, name: str) -> "ast.expr | None":
+    for kw in call.keywords:
+        if kw.arg in ("axis_name", "axis"):
+            return kw.value
+    idx = COLLECTIVES[name]
+    if idx < len(call.args):
+        return call.args[idx]
+    return None
+
+
+class _ModuleConstants:
+    """Module-level string constants, with one import hop into tpfl."""
+
+    def __init__(self, root: pathlib.Path) -> None:
+        self.root = root
+        self._consts: dict[str, dict[str, str]] = {}  # relpath -> name -> s
+
+    def constants(self, relpath: str) -> dict[str, str]:
+        cached = self._consts.get(relpath)
+        if cached is not None:
+            return cached
+        out: dict[str, str] = {}
+        path = self.root / relpath
+        if path.exists():
+            try:
+                tree = ast.parse(path.read_text(encoding="utf-8"))
+            except SyntaxError:
+                tree = ast.Module(body=[], type_ignores=[])
+            for node in tree.body:
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    tgt, val = node.targets[0], node.value
+                    if (
+                        isinstance(tgt, ast.Name)
+                        and isinstance(val, ast.Constant)
+                        and isinstance(val.value, str)
+                    ):
+                        out[tgt.id] = val.value
+        self._consts[relpath] = out
+        return out
+
+
+def _import_map(tree: ast.Module) -> dict[str, str]:
+    """imported name -> tpfl module relpath (for constant resolution)."""
+    out: dict[str, str] = {}
+    for node in tree.body:
+        if isinstance(node, ast.ImportFrom) and node.module:
+            if not node.module.startswith("tpfl"):
+                continue
+            relpath = node.module.replace(".", "/") + ".py"
+            for a in node.names:
+                out[a.asname or a.name] = relpath
+    return out
+
+
+class _Scope:
+    """One function def with its parent chain and local assignments."""
+
+    def __init__(self, fn: ast.AST, parent: "._Scope | None", cls: "str | None"):
+        self.fn = fn
+        self.parent = parent
+        self.cls = cls
+        args = fn.args
+        self.params = [
+            a.arg for a in (args.posonlyargs + args.args + args.kwonlyargs)
+        ]
+        self.defaults: dict[str, ast.expr] = {}
+        pos = args.posonlyargs + args.args
+        for a, d in zip(pos[len(pos) - len(args.defaults):], args.defaults):
+            self.defaults[a.arg] = d
+        for a, d in zip(args.kwonlyargs, args.kw_defaults):
+            if d is not None:
+                self.defaults[a.arg] = d
+        self.assigns: dict[str, ast.expr] = {}
+
+        def visit(node: ast.AST, top: bool = False) -> None:
+            if not top and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                return
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                if isinstance(t, ast.Name):
+                    self.assigns[t.id] = node.value
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        visit(fn, top=True)
+
+    def outermost(self) -> "._Scope":
+        s = self
+        while s.parent is not None:
+            s = s.parent
+        return s
+
+    def lookup(self, name: str) -> "tuple[str, ast.expr | None]":
+        """('param', None) | ('local', expr) | ('unknown', None),
+        walking the closure chain."""
+        s: "_Scope | None" = self
+        while s is not None:
+            if name in s.assigns:
+                return ("local", s.assigns[name])
+            if name in s.params:
+                return ("param", None)
+            s = s.parent
+        return ("unknown", None)
+
+
+class _ModuleInfo:
+    """Per-file scopes, binding sets, collective sites, call edges."""
+
+    def __init__(self, relpath: str, tree: ast.Module, consts: _ModuleConstants):
+        self.relpath = relpath
+        self.tree = tree
+        self.local_consts = {
+            t.id: v.value
+            for t, v in (
+                (n.targets[0], n.value)
+                for n in tree.body
+                if isinstance(n, ast.Assign) and len(n.targets) == 1
+            )
+            if isinstance(t, ast.Name)
+            and isinstance(v, ast.Constant)
+            and isinstance(v.value, str)
+        }
+        self.imports = _import_map(tree)
+        self._consts = consts
+        self.scopes: dict[int, _Scope] = {}  # id(fn node) -> scope
+        self.fn_by_name: dict[tuple["str | None", str], ast.AST] = {}
+        self.module_bindings: set[str] = set()
+        self._index(tree)
+
+    def _index(self, tree: ast.Module) -> None:
+        def walk(node: ast.AST, parent: "._Scope | None", cls: "str | None"):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    walk(child, parent, child.name)
+                elif isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    scope = _Scope(child, parent, cls)
+                    self.scopes[id(child)] = scope
+                    self.fn_by_name.setdefault((cls, child.name), child)
+                    if cls is not None:
+                        # bare-name resolution also finds methods
+                        self.fn_by_name.setdefault((None, child.name), child)
+                    walk(child, scope, cls)
+                else:
+                    walk(child, parent, cls)
+
+        walk(tree, None, None)
+        self.module_bindings = self._bindings(tree)
+
+    def _bindings(self, node: ast.AST) -> set[str]:
+        """Axis symbols bound in ``node``'s subtree: names/strings in
+        PartitionSpec(...), axis_name(s)= kwargs, mesh axis dicts."""
+        out: set[str] = set()
+
+        def add_expr(e: ast.AST) -> None:
+            for sub in ast.walk(e):
+                if isinstance(sub, ast.Name):
+                    out.add(sub.id)
+                elif isinstance(sub, ast.Constant) and isinstance(
+                    sub.value, str
+                ):
+                    out.add(sub.value)
+
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            f = sub.func
+            fname = (
+                f.id
+                if isinstance(f, ast.Name)
+                else f.attr if isinstance(f, ast.Attribute) else ""
+            )
+            if fname == "PartitionSpec":
+                for a in sub.args:
+                    add_expr(a)
+            if fname in ("create_mesh", "Mesh", "make_mesh"):
+                for a in list(sub.args) + [k.value for k in sub.keywords]:
+                    if isinstance(a, ast.Dict):
+                        for k in a.keys:
+                            if k is not None:
+                                add_expr(k)
+                    else:
+                        add_expr(a)
+            for kw in sub.keywords:
+                if kw.arg in ("axis_name", "axis_names"):
+                    add_expr(kw.value)
+        return out
+
+    def outer_bindings(self, scope: _Scope) -> set[str]:
+        return self._bindings(scope.outermost().fn) | self.module_bindings
+
+    def resolve_to_strings(self, name: str) -> set[str]:
+        """Constant strings a bare name may denote (local module
+        constant or a one-hop tpfl import)."""
+        out: set[str] = set()
+        if name in self.local_consts:
+            out.add(self.local_consts[name])
+        src = self.imports.get(name)
+        if src is not None:
+            v = self._consts.constants(src).get(name)
+            if v is not None:
+                out.add(v)
+        return out
+
+
+def _axis_symbols(
+    expr: ast.AST, scope: _Scope, mod: _ModuleInfo, depth: int = 0
+) -> "tuple[set[str], bool]":
+    """(symbols, param_rooted): names/strings the axis expression may
+    denote, and whether any path roots in a function parameter."""
+    if depth > 4:
+        return set(), False
+    if isinstance(expr, ast.Constant):
+        if isinstance(expr.value, str):
+            return {expr.value}, False
+        return set(), False
+    if isinstance(expr, ast.IfExp):
+        s1, p1 = _axis_symbols(expr.body, scope, mod, depth + 1)
+        s2, p2 = _axis_symbols(expr.orelse, scope, mod, depth + 1)
+        return s1 | s2, p1 or p2
+    if isinstance(expr, ast.Name):
+        kind, bound = scope.lookup(expr.id)
+        if kind == "param":
+            return {expr.id}, True
+        if kind == "local" and bound is not None:
+            syms, rooted = _axis_symbols(bound, scope, mod, depth + 1)
+            return syms | {expr.id}, rooted
+        # module constant / import
+        strings = mod.resolve_to_strings(expr.id)
+        if strings:
+            return strings | {expr.id}, False
+        return {expr.id}, False
+    return set(), False
+
+
+def _own_nodes(fn: ast.AST):
+    """Nodes belonging to ``fn``'s own scope: the walk stops at nested
+    FunctionDefs (their own _Scope covers them) but descends into
+    lambdas (which share the enclosing scope here)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _call_edges(
+    mod: _ModuleInfo,
+) -> "dict[tuple[str | None, str], list[tuple[_Scope, ast.Call, str | None]]]":
+    """callee (cls, name) -> [(caller scope, call node, partial kw)]
+    for bare-name, self.-method, and partial(fn, ...) call sites."""
+    edges: dict = {}
+    for fn_id, scope in mod.scopes.items():
+        for node in _own_nodes(scope.fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            target: "tuple[str | None, str] | None" = None
+            call = node
+            if isinstance(f, ast.Name):
+                if f.id == "partial" and node.args:
+                    inner = node.args[0]
+                    if isinstance(inner, ast.Name):
+                        target = (None, inner.id)
+                else:
+                    target = (None, f.id)
+            elif isinstance(f, ast.Attribute) and isinstance(
+                f.value, ast.Name
+            ) and f.value.id in ("self", "cls"):
+                target = (scope.cls, f.attr)
+            if target is None:
+                continue
+            edges.setdefault(target, []).append((scope, call, None))
+    return edges
+
+
+def _arg_for_param(
+    call: ast.Call, callee_scope: _Scope, param: str
+) -> "ast.expr | None":
+    """The expression the call passes for ``param`` (positional,
+    keyword, or the callee's default)."""
+    for kw in call.keywords:
+        if kw.arg == param:
+            return kw.value
+    params = [p for p in callee_scope.params if p not in ("self", "cls")]
+    # partial(fn, ...) positional offset: first arg is the fn itself
+    args = list(call.args)
+    if (
+        isinstance(call.func, ast.Name)
+        and call.func.id == "partial"
+        and args
+    ):
+        args = args[1:]
+    try:
+        idx = params.index(param)
+    except ValueError:
+        return None
+    if idx < len(args):
+        return args[idx]
+    return callee_scope.defaults.get(param)
+
+
+def check_spmd(repo: "pathlib.Path | None" = None) -> list[Violation]:
+    root = repo_root(repo)
+    consts = _ModuleConstants(root)
+    violations: list[Violation] = []
+    for path in py_files(root):
+        r = rel(root, path)
+        src = path.read_text(encoding="utf-8")
+        # Cheap textual pre-filter: most modules have no collectives
+        # at all — skip the full scope/edge index for them.
+        if not any(
+            tok in src
+            for tok in ("psum", "all_gather", "axis_index", "ppermute",
+                        "pmean", "pmax", "pmin", "all_to_all", "pshuffle")
+        ):
+            continue
+        try:
+            tree = ast.parse(src)
+        except SyntaxError:
+            continue
+        mod = _ModuleInfo(r, tree, consts)
+        edges = _call_edges(mod)
+        for fn_id, scope in mod.scopes.items():
+            for node in _own_nodes(scope.fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                cname = _collective_name(node)
+                if cname is None:
+                    continue
+                axis = _axis_expr(node, cname)
+                if axis is None:
+                    continue
+                if not _axis_bound(mod, edges, scope, axis, set()):
+                    violations.append(
+                        Violation(
+                            "spmd", r, node.lineno,
+                            f"lax.{cname} names an axis that no enclosing "
+                            "shard_map/vmap/pmap binds in any statically-"
+                            "visible caller — an unbound axis name fails "
+                            "only on the (untested) path that traces it",
+                            f"spmd:{r}:{node.lineno}",
+                        )
+                    )
+                if cname == "axis_index":
+                    v = _dead_axis_index(mod, scope, node)
+                    if v is not None:
+                        violations.append(
+                            Violation(
+                                "spmd", r, v,
+                                "axis_index result is never consumed — a "
+                                "user-less axis_index never receives the "
+                                "{manual} sharding and the SPMD "
+                                "partitioner rejects the program (the "
+                                "PR-10 flash-ring bug class); delete it "
+                                "or consume its result",
+                                f"spmd:{r}:{v}:dead",
+                            )
+                        )
+        # dedupe
+    uniq: dict[str, Violation] = {}
+    for v in violations:
+        uniq.setdefault(v.key, v)
+    return list(uniq.values())
+
+
+def _axis_bound(
+    mod: _ModuleInfo,
+    edges: dict,
+    scope: _Scope,
+    axis: ast.AST,
+    visited: set,
+) -> bool:
+    symbols, param_rooted = _axis_symbols(axis, scope, mod)
+    if not symbols and not param_rooted:
+        return True  # unresolvable expression — stay silent, not wrong
+    bindings = mod.outer_bindings(scope)
+    resolved = set(symbols)
+    for s in list(symbols):
+        resolved |= mod.resolve_to_strings(s)
+    if resolved & bindings:
+        return True
+    if not param_rooted:
+        return False
+    # Obligation transfers to callers of the outermost enclosing fn.
+    outer = scope.outermost()
+    key = (outer.cls, getattr(outer.fn, "name", ""))
+    if key in visited:
+        return True  # recursion — give up quietly
+    visited = visited | {key}
+    param_names = [s for s in symbols if s in _all_params(scope)]
+    callers = edges.get(key, []) + edges.get((None, key[1]), [])
+    if not callers:
+        return True  # public inner API — callers outside the repo bind it
+    for caller_scope, call, _ in callers:
+        for p in param_names:
+            arg = _arg_for_param(call, outer, p)
+            if arg is None:
+                continue
+            if not _axis_bound(mod, edges, caller_scope, arg, visited):
+                return False
+    return True
+
+
+def _all_params(scope: _Scope) -> set[str]:
+    out: set[str] = set()
+    s: "_Scope | None" = scope
+    while s is not None:
+        out |= set(s.params)
+        s = s.parent
+    return out
+
+
+def _dead_axis_index(
+    mod: _ModuleInfo, scope: _Scope, call: ast.Call
+) -> "int | None":
+    """Line number of a dead axis_index, or None when consumed."""
+    # Find the statement containing the call within the scope body.
+    for stmt in ast.walk(scope.fn):
+        if isinstance(stmt, ast.Expr) and _contains(stmt.value, call):
+            if stmt.value is call:
+                return call.lineno  # bare statement — dead
+            return None  # part of a larger consumed expression
+        if isinstance(stmt, ast.Assign) and _contains(stmt.value, call):
+            if len(stmt.targets) == 1 and isinstance(
+                stmt.targets[0], ast.Name
+            ):
+                name = stmt.targets[0].id
+                # Consumed when the name is loaded anywhere in the
+                # outermost function after binding (closures included).
+                outer_fn = scope.outermost().fn
+                for sub in ast.walk(outer_fn):
+                    if (
+                        isinstance(sub, ast.Name)
+                        and sub.id == name
+                        and isinstance(sub.ctx, ast.Load)
+                    ):
+                        return None
+                return stmt.lineno
+            return None
+    return None  # used inline (return/condition/arithmetic) — consumed
+
+
+def _contains(tree: ast.AST, node: ast.AST) -> bool:
+    return any(sub is node for sub in ast.walk(tree))

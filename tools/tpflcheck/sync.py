@@ -1,0 +1,272 @@
+"""Host-sync lint: implicit device→host syncs on the hot path.
+
+On TPU every ``.item()``, ``float(device_array)``, ``np.asarray(...)``
+of a device value, and bare ``block_until_ready`` stalls the host on
+the device queue — the async dispatch pipeline that hides the ~67 ms
+RTT collapses, and one stray debug cast costs a whole round of
+overlap. The profiling observatory measures these gaps
+(``tpfl_round_attr_seconds`` dispatch vs train); this lint keeps new
+ones from creeping into the modules where the gap is the product.
+
+Scope: :data:`HOT_PATHS` — the engine round dispatch, the vmapped
+federation, the learner fit/eval seams, the batched-fit pool, and the
+aggregator eager-fold family. Flags, per function scope:
+
+1. ``<expr>.item()`` — always a sync.
+2. ``jax.block_until_ready(...)`` / ``<expr>.block_until_ready()``.
+3. ``np.asarray(x)`` / ``np.array(x)`` where ``x`` is a bare
+   name/attribute/subscript (a device-value candidate; literals and
+   comprehensions are host data).
+4. ``float(x)`` / ``int(x)`` / ``bool(x)`` where ``x``'s root name is
+   **device-tracked**: bound (possibly via tuple-unpacking) from a
+   call of a compiled-program callable — a name that is exactly
+   ``fn`` or ends in ``_fn`` / ``_program`` / ``.run_rounds`` /
+   ``.evaluate`` (the repo's program-handle naming convention, which
+   the capture pass's cache-getter discipline reinforces). Re-binding
+   a tracked name from ``np.asarray(...)`` UN-tracks it: that line is
+   the one accounted sync, everything after reads host memory.
+
+Exemptions:
+
+- a sync inside an ``if``/``while`` whose condition mentions an
+  observability gate (``prof``, ``tele``, profiling / telemetry /
+  ledger knobs, ``...enabled()``, debug-level checks) — gated
+  measurement taps are the sanctioned pattern: zero syncs when off;
+- ``# host-sync: <reason>`` on the line (or the comment block above)
+  — for deliberate syncs at consumption boundaries (eval metrics,
+  end-of-chunk result folds), with the reason as reviewable data.
+
+Waiver keys: ``sync:<file>:<line>``.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+
+from tools.tpflcheck.core import Violation, repo_root
+
+#: The hot-path roster: modules where a stray sync costs round overlap.
+HOT_PATHS = (
+    "tpfl/parallel/engine.py",
+    "tpfl/parallel/federation.py",
+    "tpfl/parallel/federation_learner.py",
+    "tpfl/learning/jax_learner.py",
+    "tpfl/simulation/batched_fit.py",
+    "tpfl/learning/aggregators/aggregator.py",
+    "tpfl/learning/aggregators/fedavg.py",
+    "tpfl/learning/aggregators/fedmedian.py",
+    "tpfl/learning/aggregators/robust.py",
+    "tpfl/learning/aggregators/scaffold.py",
+)
+
+_ANNOT_RE = re.compile(r"#\s*host-sync:\s*(\S.*)$")
+_GATE_RE = re.compile(
+    r"prof|tele|ledger|LEDGER|PROFIL|TELEMETRY|DEBUG|debug|enabled|verbose"
+)
+
+#: Callee name shapes whose results are device arrays (the compiled-
+#: program handle convention: `fn = cache[key]; out = fn(...)`).
+_PROGRAM_CALLEES = re.compile(r"(^fn$|_fn$|_program$|^run_rounds$|^evaluate$)")
+
+_CASTS = {"float", "int", "bool"}
+_NP_NAMES = {"np", "numpy"}
+
+
+def _annotated(lines: list[str], lineno: int) -> bool:
+    candidates = [lines[lineno - 1]]
+    i = lineno - 2
+    while i >= 0 and lines[i].strip().startswith("#"):
+        candidates.append(lines[i])
+        i -= 1
+    return any(_ANNOT_RE.search(text) for text in candidates)
+
+
+def _root_name(expr: ast.AST) -> "str | None":
+    while isinstance(expr, (ast.Subscript, ast.Attribute)):
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def _callee_terminal(call: ast.Call) -> "str | None":
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+class _FnChecker:
+    def __init__(
+        self, relpath: str, fn: ast.AST, lines: list[str]
+    ) -> None:
+        self.r = relpath
+        self.fn = fn
+        self.lines = lines
+        self.tracked: set[str] = set()
+        self.violations: list[Violation] = []
+        # gate stack: are we under an observability-gated branch?
+        self._gates = 0
+
+    # --- helpers ---
+
+    def _flag(self, lineno: int, what: str, hint: str) -> None:
+        if self._gates > 0 or _annotated(self.lines, lineno):
+            return
+        self.violations.append(
+            Violation(
+                "sync", self.r, lineno,
+                f"{what} on the hot path forces a device→host sync "
+                f"(stalls the async dispatch pipeline) — {hint}, gate "
+                "it behind an observability knob, or annotate "
+                "'# host-sync: <reason>'",
+                f"sync:{self.r}:{lineno}",
+            )
+        )
+
+    def _is_gate(self, test: ast.AST) -> bool:
+        try:
+            src = ast.unparse(test)
+        except Exception:
+            return False
+        return bool(_GATE_RE.search(src))
+
+    # --- walk ---
+
+    def run(self) -> None:
+        for stmt in ast.iter_child_nodes(self.fn):
+            self._stmt(stmt)
+
+    def _stmt(self, node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return  # nested scope: its own checker run covers it
+        if isinstance(node, (ast.If, ast.While)):
+            self._expr(node.test)
+            gated = self._is_gate(node.test)
+            if gated:
+                self._gates += 1
+            for sub in node.body:
+                self._stmt(sub)
+            if gated:
+                self._gates -= 1
+            for sub in node.orelse:
+                self._stmt(sub)
+            return
+        if isinstance(node, ast.Assign):
+            self._expr(node.value)
+            self._track_assign(node)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._expr(child)
+            else:
+                self._stmt(child)
+
+    def _track_assign(self, node: ast.Assign) -> None:
+        val = node.value
+        from_program = (
+            isinstance(val, ast.Call)
+            and (t := _callee_terminal(val)) is not None
+            and _PROGRAM_CALLEES.search(t) is not None
+        )
+        # np.asarray(...) re-bind: the value is host now.
+        to_host = (
+            isinstance(val, ast.Call)
+            and _callee_terminal(val) in ("asarray", "array")
+            and isinstance(val.func, ast.Attribute)
+            and isinstance(val.func.value, ast.Name)
+            and val.func.value.id in _NP_NAMES
+        )
+        from_tracked = (
+            isinstance(val, ast.Name) and val.id in self.tracked
+        )
+        targets: list[str] = []
+        for t_ in node.targets:
+            if isinstance(t_, ast.Name):
+                targets.append(t_.id)
+            elif isinstance(t_, ast.Tuple):
+                targets.extend(
+                    e.id for e in t_.elts if isinstance(e, ast.Name)
+                )
+        for name in targets:
+            if to_host:
+                self.tracked.discard(name)
+            elif from_program or from_tracked:
+                self.tracked.add(name)
+            else:
+                self.tracked.discard(name)
+
+    def _expr(self, node: ast.AST) -> None:
+        for sub in ast.walk(node):
+            if isinstance(
+                sub, (ast.Lambda, ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            if not isinstance(sub, ast.Call):
+                continue
+            term = _callee_terminal(sub)
+            if term == "item" and isinstance(sub.func, ast.Attribute):
+                self._flag(
+                    sub.lineno, ".item()",
+                    "batch scalars into one fetch",
+                )
+            elif term == "block_until_ready":
+                self._flag(
+                    sub.lineno, "block_until_ready",
+                    "let the async dispatch run ahead",
+                )
+            elif (
+                term in ("asarray", "array")
+                and isinstance(sub.func, ast.Attribute)
+                and isinstance(sub.func.value, ast.Name)
+                and sub.func.value.id in _NP_NAMES
+                and sub.args
+                and isinstance(
+                    sub.args[0], (ast.Name, ast.Attribute, ast.Subscript)
+                )
+            ):
+                self._flag(
+                    sub.lineno, f"np.{term}(...) of a device value",
+                    "keep it on device (jnp) or sync once at the "
+                    "consumption boundary",
+                )
+            elif (
+                isinstance(sub.func, ast.Name)
+                and sub.func.id in _CASTS
+                and sub.args
+            ):
+                root = _root_name(sub.args[0])
+                if root is not None and root in self.tracked:
+                    self._flag(
+                        sub.lineno,
+                        f"{sub.func.id}() of a compiled-program result",
+                        "the cast blocks on the device queue",
+                    )
+
+
+def check_sync(repo: "pathlib.Path | None" = None) -> list[Violation]:
+    root = repo_root(repo)
+    violations: list[Violation] = []
+    for relpath in HOT_PATHS:
+        path = root / relpath
+        if not path.exists():
+            continue
+        src = path.read_text(encoding="utf-8")
+        try:
+            tree = ast.parse(src)
+        except SyntaxError:
+            continue
+        lines = src.splitlines()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                checker = _FnChecker(relpath, node, lines)
+                checker.run()
+                violations.extend(checker.violations)
+    uniq: dict[str, Violation] = {}
+    for v in violations:
+        uniq.setdefault(v.key, v)
+    return list(uniq.values())

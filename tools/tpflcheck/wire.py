@@ -1,8 +1,8 @@
 """Wire-path lints (moved from the original ``tools/wirecheck.py``).
 
 Three checks, unchanged in behavior, now sharing tpflcheck's walk and
-reporting machinery (``tools/wirecheck.py`` remains as a shim so the
-original entry point and test imports keep working):
+reporting machinery (``tools/wirecheck.py`` is retired — import this
+module directly; ``python -m tools.tpflcheck`` runs everything):
 
 - :func:`check` — model payloads must go through the codec registry:
   raw ``serialization.encode_pytree`` / ``encode_model_payload`` /

@@ -1,72 +1,15 @@
 #!/usr/bin/env python
-"""Thin shim — the wire lints moved into the tpflcheck suite.
+"""RETIRED — the wire lints live in ``tools.tpflcheck.wire``.
 
-``tools/wirecheck.py`` grew two siblings (copy-discipline, RPC-path)
-and then a whole framework: guarded-by race lint, lock-order deadlock
-detection, layer/knob/thread lints — ``tools/tpflcheck/``. The three
-original checks live in :mod:`tools.tpflcheck.wire` unchanged; this
-file keeps the historical entry point (``python tools/wirecheck.py``)
-and the ``import wirecheck`` surface the test suite uses.
-
-Prefer ``python -m tools.tpflcheck`` — it runs these three checks AND
-the rest of the suite.
+This shim carried the historical ``python tools/wirecheck.py`` entry
+point and ``import wirecheck`` surface for two deprecation cycles
+after the checks moved into the tpflcheck suite (PR 4). Every in-repo
+call site now imports ``tools.tpflcheck.wire`` directly; run
+``python -m tools.tpflcheck`` for the full suite.
 """
 
-from __future__ import annotations
-
-import pathlib
-import sys
-
-_ROOT = pathlib.Path(__file__).resolve().parent.parent
-if str(_ROOT) not in sys.path:
-    sys.path.insert(0, str(_ROOT))
-
-from tools.tpflcheck.wire import (  # noqa: E402  (path bootstrap above)
-    check,
-    check_copies,
-    check_rpc,
+raise ImportError(
+    "tools/wirecheck.py is retired: import tools.tpflcheck.wire "
+    "(check / check_copies / check_rpc) or run "
+    "`python -m tools.tpflcheck` for the full suite"
 )
-
-__all__ = ["check", "check_copies", "check_rpc", "main"]
-
-
-def main() -> int:
-    rc = 0
-    for label, fn, ok_msg, fail_msg in (
-        (
-            "wire",
-            check,
-            "all model payload paths go through the codec registry",
-            "model payloads serialized outside the codec registry "
-            "(route through TpflModel.encode_parameters or "
-            "tpfl.learning.compression)",
-        ),
-        (
-            "copies",
-            check_copies,
-            "no array-byte copies outside the serialization layer",
-            "array bytes copied outside the serialization layer "
-            "(route through serialization.leaf_bytes or the zero-copy "
-            "decode views)",
-        ),
-        (
-            "rpc",
-            check_rpc,
-            "all outbound RPC call sites go through the retrying send path",
-            "raw RPC/transport call sites bypass the retrying send path "
-            "(route through ThreadedCommunicationProtocol.send)",
-        ),
-    ):
-        violations = fn()
-        if violations:
-            print(f"wirecheck FAILED — {fail_msg}:", file=sys.stderr)
-            for v in violations:
-                print(f"  {v}", file=sys.stderr)
-            rc = 1
-        else:
-            print(f"wirecheck OK — {ok_msg}")
-    return rc
-
-
-if __name__ == "__main__":
-    sys.exit(main())

@@ -30,6 +30,17 @@ setting ``Settings.LOCK_TRACING = True`` before building nodes. The
 overhead is one thread-local list append per acquire (<10% round
 throughput in bench.py's analysis tier), cheap enough for every chaos
 run but not free enough for the 1000-node profiles.
+
+This module also hosts the TRACE-CONTRACT machinery
+(:func:`stamp_contract` / :func:`check_contract`,
+``Settings.TRACE_CONTRACTS``) — the runtime half of tpflcheck's
+*capture* pass the same way TracedLock is the runtime half of its
+*locks* pass: the static pass proves at review time that every knob a
+dispatch resolves is an axis of the program-cache key; the contract
+stamp catches at RUN time what static analysis cannot see (dynamic
+dispatch, monkeypatched caches), failing loudly with a named knob
+witness instead of silently running a stale compiled program. See
+docs/static_analysis.md.
 """
 
 from __future__ import annotations
@@ -199,6 +210,79 @@ class TracedLock:
 
     def __repr__(self) -> str:
         return f"TracedLock({self.name!r}, locked={self.locked()})"
+
+
+# --- trace contracts (runtime half of tpflcheck's capture pass) ----------
+
+
+class TraceContractError(RuntimeError):
+    """A cached compiled program was dispatched under live Settings
+    values that differ from the ones its cache key was built from —
+    a cache key lost an axis, and a STALE program was about to run.
+    The message names the offending knob(s) and both values."""
+
+
+class ContractedProgram:
+    """Callable wrapper stamping a cached compiled program with the
+    knob values its cache key encodes (``stamp_contract``). Dispatch
+    paths re-check the stamp against the live resolved values
+    (``check_contract``) — the runtime counterpart of the static
+    capture pass's key-totality rule, and like :class:`TracedLock`
+    only ever constructed when the debug knob is on, so production
+    pays zero wrappers.
+
+    Attribute access forwards to the wrapped program (``.lower`` and
+    friends keep working); ``contract`` is the stamp itself."""
+
+    __slots__ = ("fn", "contract")
+
+    def __init__(self, fn: "object", contract: dict) -> None:
+        self.fn = fn
+        self.contract = dict(contract)
+
+    def __call__(self, *args: object, **kwargs: object) -> object:
+        return self.fn(*args, **kwargs)  # type: ignore[operator]
+
+    def __getattr__(self, name: str) -> object:
+        return getattr(self.fn, name)
+
+    def __repr__(self) -> str:
+        return f"ContractedProgram({self.contract!r})"
+
+
+def stamp_contract(fn: "object", contract: dict) -> "object":
+    """Wrap a freshly-built cached program with the knob values its
+    cache key was built from. No-op (returns ``fn`` unwrapped) unless
+    ``Settings.TRACE_CONTRACTS`` is on at BUILD time — the make_lock
+    discipline: production never pays the wrapper."""
+    if Settings.TRACE_CONTRACTS:
+        return ContractedProgram(fn, contract)
+    return fn
+
+
+def check_contract(fn: "object", live: dict) -> None:
+    """Assert a cache-fetched program's stamped knob values match the
+    live per-dispatch resolution. Unstamped callables (contracts off
+    at build time) pass silently; a mismatch raises
+    :class:`TraceContractError` with a named witness per knob."""
+    contract = getattr(fn, "contract", None)
+    if not isinstance(contract, dict):
+        return
+    mismatches = [
+        (k, v, live[k]) for k, v in sorted(contract.items())
+        if k in live and live[k] != v
+    ]
+    if mismatches:
+        parts = ", ".join(
+            f"{k}: compiled under {v!r}, live value {lv!r}"
+            for k, v, lv in mismatches
+        )
+        raise TraceContractError(
+            "stale compiled program: the cache key is not total over "
+            f"the knobs it serves — {parts} (every knob a dispatch "
+            "resolves must be an axis of the program-cache key; see "
+            "tools/tpflcheck capture pass / docs/static_analysis.md)"
+        )
 
 
 def make_lock(name: str) -> Union[threading.Lock, TracedLock]:

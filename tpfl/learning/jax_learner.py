@@ -22,6 +22,7 @@ one TPU-native learner:
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 import zlib
@@ -532,21 +533,29 @@ class JaxLearner(Learner):
             n_steps += xs.shape[0]
             if in_exp:
                 logger.log_metric(
-                    self._addr, "train_loss", float(loss), step=epoch
+                    self._addr,
+                    "train_loss",
+                    # host-sync: experiment metric tap — one scalar
+                    # fetch per epoch is the loss curve's price.
+                    float(loss),
+                    step=epoch,
                 )
-            # Learning-plane fit seam: the loss-trajectory monitor
-            # rides the float() the debug line below already forces —
-            # no added device sync, one attribute read when off.
+            # Learning-plane fit seam: one attribute read when off.
             if Settings.LEDGER_ENABLED:
                 ledger.convergence.observe_loss(
                     self._addr,
                     self._round_counter * 10_000 + epoch,
                     float(loss),
                 )
-            logger.debug(
-                self._addr,
-                f"epoch {epoch}: loss={float(loss):.4f} acc={float(acc):.4f}",
-            )
+            if logger.get_level() <= logging.DEBUG:
+                # The f-string's float() casts block on the device
+                # queue — level-gated so the non-debug hot path keeps
+                # its async dispatch overlap (sync lint).
+                logger.debug(
+                    self._addr,
+                    f"epoch {epoch}: loss={float(loss):.4f} "
+                    f"acc={float(acc):.4f}",
+                )
         self._round_counter += 1
 
         if n_steps == 0:
@@ -630,6 +639,8 @@ class JaxLearner(Learner):
             jnp.asarray(ys),
             jnp.asarray(ms),
         )
+        # host-sync: evaluation's consumption boundary — the confusion
+        # matrix and loss are the product, fetched once per evaluate().
         cm = np.asarray(cm, np.float64)
         tp = np.diag(cm)
         support = cm.sum(axis=1)  # true counts per class
@@ -644,7 +655,7 @@ class JaxLearner(Learner):
                 0.0,
             )
         metrics = {
-            "test_loss": float(loss),
+            "test_loss": float(loss),  # host-sync: eval product
             "test_metric": float(tp.sum() / max(cm.sum(), 1.0)),  # accuracy
             "test_precision": float(precision[present].mean()),
             "test_recall": float(recall[present].mean()),

@@ -111,7 +111,7 @@ def leaf_bytes(a: np.ndarray) -> "memoryview | bytes":
     export the buffer protocol (ml_dtypes bfloat16/float8) go through a
     uint8 reinterpret view; ``tobytes()`` remains only as the last
     fallback. The ONLY sanctioned byte-extraction helper outside jitted
-    code — ``tools/wirecheck.py check_copies`` lints stray copies."""
+    code — ``tools.tpflcheck.wire.check_copies`` lints stray copies."""
     a = _as_contiguous(np.asarray(a))
     flat = a.reshape(-1)  # 0-d -> (1,); reshape of contiguous is a view
     try:

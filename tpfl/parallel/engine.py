@@ -68,6 +68,7 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from tpfl import concurrency
 from tpfl.learning import compression
 from tpfl.learning.jax_learner import (
     TrainState,
@@ -934,10 +935,25 @@ class FederationEngine:
                 + (":atk" if a_ndim else "")
                 + (f":{compression.codec_name(codec)}" if codec else "")
             )
-            fn = self._wrapped[key] = profiling.observatory.wrap(
+            wrapped = profiling.observatory.wrap(
                 self.program(*key),
                 f"engine_round:{kind}x{n_rounds}{suffix}:"
                 f"{profiling.module_tag(self.module)}",
+            )
+            # TRACE_CONTRACTS (off = no wrapper): stamp the program
+            # with the knob values its cache key encodes, so a future
+            # key-hygiene bug fails at dispatch with a named witness
+            # instead of silently serving this program under other
+            # knob values (tpfl.concurrency, the capture pass's
+            # runtime half).
+            fn = self._wrapped[key] = concurrency.stamp_contract(
+                wrapped,
+                {
+                    "ENGINE_TELEMETRY": bool(telemetry),
+                    "ENGINE_WIRE_CODEC": int(codec),
+                    "WIRE_TOPK_FRAC": float(topk_frac),
+                    "ENGINE_DONATE": bool(donate),
+                },
             )
         return fn
 
@@ -1138,6 +1154,18 @@ class FederationEngine:
             kind, epochs, n_rounds, w.ndim, donate, tele_on, a_ndim,
             codec, frac,
         )
+        if Settings.TRACE_CONTRACTS:
+            # Dispatch-time contract: the fetched program's build-time
+            # stamp must match THIS dispatch's resolved knob values.
+            concurrency.check_contract(
+                fn,
+                {
+                    "ENGINE_TELEMETRY": bool(tele_on),
+                    "ENGINE_WIRE_CODEC": int(codec),
+                    "WIRE_TOPK_FRAC": float(frac),
+                    "ENGINE_DONATE": bool(donate),
+                },
+            )
 
         prof = profiling.rounds.enabled()
         node_tag = f"engine:{profiling.module_tag(self.module)}"

@@ -202,7 +202,8 @@ class FederationLearner(Learner):
         losses, accs = fed.evaluate(
             self._stack(model.get_parameters()), xs, ys, aux=aux
         )
-        return {
-            "test_loss": float(np.mean(np.asarray(losses))),
-            "test_metric": float(np.mean(np.asarray(accs))),
-        }
+        # host-sync: evaluation's consumption boundary — the metrics
+        # are the product, fetched once per evaluate().
+        loss_v = float(np.mean(np.asarray(losses)))
+        acc_v = float(np.mean(np.asarray(accs)))  # host-sync: eval product
+        return {"test_loss": loss_v, "test_metric": acc_v}

@@ -689,6 +689,21 @@ class Settings:
     False: every dispatch allocates fresh outputs (debugging aid)."""
 
     # --- concurrency diagnostics ---
+    TRACE_CONTRACTS: bool = False
+    """Opt-in runtime trace-contract checking (tpfl.concurrency): every
+    compiled program the federation engine caches is stamped with the
+    Settings-knob values its cache key was built from
+    (``ENGINE_TELEMETRY`` / ``ENGINE_WIRE_CODEC`` / ``WIRE_TOPK_FRAC``
+    / ``ENGINE_DONATE``), and every dispatch re-checks the stamp
+    against the live resolved values — a mismatch means a cache key
+    lost an axis and a STALE compiled program was about to run;
+    ``TraceContractError`` names the offending knob and both values.
+    The runtime half of ``tools/tpflcheck``'s capture pass (the static
+    half proves key totality at review time; this catches what static
+    analysis cannot — indirection through dynamic dispatch). Read at
+    program BUILD time like ``LOCK_TRACING``; off by default (zero
+    wrappers, zero per-dispatch reads)."""
+
     LOCK_TRACING: bool = False
     """Opt-in runtime lock-order tracing (tpfl.concurrency): every lock
     built through ``make_lock`` becomes a ``TracedLock`` that records
@@ -748,6 +763,7 @@ class Settings:
         cls.ASYNC_LOGGER = False
         cls.FILE_LOGGER = False
         cls.LOCK_TRACING = False
+        cls.TRACE_CONTRACTS = False
         # Exactness first in tests: dense payloads (v3 zero-copy layout
         # — still exact), no residual gossip; codec tests opt in
         # explicitly. Zero-copy stays byte-path (INPROC_ZERO_COPY off)
@@ -871,6 +887,7 @@ class Settings:
         cls.FILE_LOGGER = True
         cls.WIRE_CHUNK_SIZE = 256 * 1024
         cls.LOCK_TRACING = False
+        cls.TRACE_CONTRACTS = False
         # Single-host, handful of nodes: bytes are not the bottleneck —
         # keep the exact dense wire (reference-parity behavior; the v3
         # layout is exact, only the framing differs). By-reference
@@ -1004,6 +1021,7 @@ class Settings:
         cls.GOSSIP_METRICS = False
         cls.WIRE_CHUNK_SIZE = 256 * 1024
         cls.LOCK_TRACING = False
+        cls.TRACE_CONTRACTS = False
         # Hundreds of round-result waiters waking 2x/s each is a
         # standing GIL tax on the trainers forming the aggregate they
         # wait for; the event still wakes them INSTANTLY on FullModel

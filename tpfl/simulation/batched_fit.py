@@ -46,7 +46,12 @@ def job_signature(learner: JaxLearner) -> tuple:
     model = learner.get_model()
     params = model.get_parameters()
     leaves = jax.tree_util.tree_leaves(params)
-    shapes = tuple((tuple(np.shape(p)), np.asarray(p).dtype.name) for p in leaves)
+    # dtype via np.dtype(p.dtype), NOT np.asarray(p): asarray of a jax
+    # leaf copies the whole tensor to host just to read its dtype —
+    # once per leaf per learner per round (caught by the sync lint).
+    shapes = tuple(
+        (tuple(np.shape(p)), np.dtype(p.dtype).name) for p in leaves
+    )
     treedef = str(jax.tree_util.tree_structure(params))
     aux_def = str(jax.tree_util.tree_structure(model.aux_state or {}))
     return (
@@ -267,7 +272,7 @@ def _run_chunk(prog: BatchedFitProgram, learners: list[JaxLearner]) -> None:
     xs_s: Any = np.stack(xs_l)
     ys_s: Any = np.stack(ys_l)
     mask_s: Any = np.stack(mask_l)
-    mus_s: Any = np.asarray(mus, np.float32)
+    mus_s: Any = np.asarray(mus, np.float32)  # host-sync: mus is a host list
 
     # Pod-scale path (Settings.SHARD_NODES): place the stacked node
     # axis over the local `nodes` mesh — the pow-2 bucket above divides
@@ -310,6 +315,8 @@ def _run_chunk(prog: BatchedFitProgram, learners: list[JaxLearner]) -> None:
             addr = j["learner"].get_addr()
             profiling.rounds.add(addr, "dispatch", t1 - t0)
             profiling.rounds.add(addr, "train", t2 - t1)
+    # host-sync: ONE deliberate sync per chunk — the window is over and
+    # every learner's finish_fit/metrics below consume losses on host.
     losses = np.asarray(losses)
 
     params_per_node = _unstack(new_params, len(jobs))
