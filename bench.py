@@ -860,7 +860,7 @@ TIERS = (
     "primary", "resnet", "attention", "transformer", "sim1000",
     "multichip", "wire", "serde", "chaos", "analysis", "telemetry",
     "profiling", "ledger", "byzantine", "async", "engine_obs",
-    "engine_wire",
+    "engine_wire", "transformer_fed",
 )
 
 
@@ -1582,6 +1582,218 @@ def _engine_wire_tier(extra: dict) -> None:
             Settings.restore(snap)
     except Exception as e:
         extra["engine_wire_error"] = str(e)[:200]
+
+
+def _transformer_fed_tier(extra: dict) -> None:
+    """Federated-transformer 2D-mesh tier (the ISSUE-15 workload: the
+    engine federating a TransformerLM over a ``nodes x model`` mesh).
+    One report, ``extra.transformer_fed``:
+
+    - rounds/sec for the SAME federation at 1x1 (single device) and
+      nodes=4 x model=2, plus MFU via the shared ``CostModel``
+      (``analytic_train_flops`` now knows the transformer shape; MFU
+      is None off-TPU like every other tier).
+    - the per-device parameter-shard drop: the 4x2 run's per-device
+      model-state bytes under the transformer SpecLayout vs the same
+      mesh with the "replicated" layout — the layout's memory win,
+      gated >= 1.5x at model=2 (sharded kernels/embeddings sit at
+      ~2x; LayerNorm/bias leaves ride replicated). ``HbmTracker``
+      peaks ride along where the backend reports memory stats (TPU).
+    - acceptance booleans: 1x1-vs-4x2 steady-loss parity within 2%
+      (accumulation tolerance — the reduction order changes), 4x2
+      same-seed byte-determinism at the fixed mesh shape, and a CLEAN
+      2D donation report (the sharded train+fold stages no copy).
+
+    On a single-device CPU host the tier re-runs itself in a
+    subprocess with 8 forced virtual devices (the multichip tier's
+    discipline — forcing XLA_FLAGS process-wide would skew the other
+    tiers' A/B budgets)."""
+    import os
+
+    import jax
+    import numpy as np
+
+    from tpfl.management.profiling import CostModel, HbmTracker
+    from tpfl.settings import Settings
+
+    try:
+        cpu = jax.default_backend() == "cpu"
+        if (
+            cpu
+            and len(jax.devices()) < 8
+            and not os.environ.get("TPFL_TRANSFORMER_FED_SUB")
+        ):
+            import subprocess
+            import sys as _sys
+
+            env = dict(
+                os.environ,
+                JAX_PLATFORMS="cpu",
+                TPFL_TRANSFORMER_FED_SUB="1",
+                XLA_FLAGS=(
+                    os.environ.get("XLA_FLAGS", "")
+                    + " --xla_force_host_platform_device_count=8"
+                ).strip(),
+            )
+            proc = subprocess.run(
+                [
+                    _sys.executable,
+                    os.path.abspath(__file__),
+                    "--tiers",
+                    "transformer_fed",
+                ],
+                capture_output=True,
+                text=True,
+                env=env,
+                timeout=1800,
+            )
+            sub = json.loads(proc.stdout.splitlines()[-1])
+            sub_extra = sub.get("extra", {})
+            if "transformer_fed" in sub_extra:
+                extra["transformer_fed"] = sub_extra["transformer_fed"]
+                extra["transformer_fed"]["subprocess_devices"] = 8
+            else:
+                extra["transformer_fed_error"] = sub_extra.get(
+                    "transformer_fed_error", "subprocess produced no tier"
+                )
+            return
+
+        from tpfl.models import TransformerLM
+        from tpfl.parallel import FederationEngine, create_mesh
+
+        snap = Settings.snapshot()
+        try:
+            Settings.set_test_settings()
+            # CPU CI shares one host's cores across the virtual
+            # devices — a miniature LM keeps the tier in the smoke
+            # budget; the TPU perf host runs a real long-context one.
+            if cpu:
+                nT, nbT, bsT, S_T = 8, 1, 4, 32
+                lm_kw = dict(vocab=128, dim=64, heads=4, n_layers=2,
+                             max_len=64)
+                R_T, reps = 4, 2
+            else:
+                nT, nbT, bsT, S_T = 8, 1, 8, 2048
+                lm_kw = dict(vocab=256, dim=512, heads=8, n_layers=4,
+                             max_len=4096)
+                R_T, reps = 8, 3
+            module = TransformerLM(**lm_kw)
+            rngT = np.random.default_rng(5)
+            xsT = rngT.integers(0, lm_kw["vocab"], (nT, nbT, bsT, S_T)).astype(
+                np.int32
+            )
+            ysT = rngT.integers(0, lm_kw["vocab"], (nT, nbT, bsT, S_T)).astype(
+                np.int32
+            )
+            mesh_2d = create_mesh(
+                {"nodes": 4, "model": 2}, devices=jax.devices()[:8]
+            )
+
+            def run(mesh, layout=None):
+                """(engine, params out, mean last-round loss, rps)."""
+                eng = FederationEngine(
+                    module, nT, mesh=mesh, seed=0, learning_rate=0.05,
+                    layout=layout,
+                )
+                p = eng.init_params((S_T,))
+                dx, dy = eng.shard_data(xsT, ysT)
+                p_out, losses = eng.run_rounds(
+                    p, dx, dy, n_rounds=R_T, donate=False
+                )  # warm: pays the compile
+                jax.block_until_ready(losses)
+                best = float("inf")
+                for _ in range(reps):
+                    t0 = time.monotonic()
+                    p_out, losses = eng.run_rounds(
+                        p, dx, dy, n_rounds=R_T, donate=False
+                    )
+                    jax.block_until_ready(losses)
+                    best = min(best, time.monotonic() - t0)
+                loss = float(
+                    np.mean(np.asarray(eng.unpad(losses))[: eng.n_nodes])
+                )
+                return eng, p_out, loss, R_T / best
+
+            _, _, loss_1, rps_1 = run(None)
+            eng2, p_2d, loss_2, rps_2 = run(mesh_2d)
+
+            def per_device_bytes(params):
+                leaves = jax.tree_util.tree_leaves(params)
+                return sum(
+                    leaf.addressable_shards[0].data.nbytes for leaf in leaves
+                )
+
+            # The layout's memory win: same 4x2 mesh, transformer
+            # layout vs node-replicated model state.
+            _, p_repl, _, _ = run(mesh_2d, layout="replicated")
+            sharded_b = per_device_bytes(p_2d)
+            repl_b = per_device_bytes(p_repl)
+
+            # Same-seed byte-determinism at the fixed 4x2 mesh shape.
+            def digest():
+                _, p, _, _ = run(mesh_2d)
+                return b"".join(
+                    np.asarray(leaf).tobytes()
+                    for leaf in jax.tree_util.tree_leaves(p)
+                )
+
+            determinism = bool(digest() == digest())
+
+            # Donation inspection on the 2D program (the run above
+            # times donate=False fixed buffers; the donating variant
+            # is the production path and must stay clean).
+            engD = FederationEngine(
+                module, nT, mesh=mesh_2d, seed=0, learning_rate=0.05
+            )
+            pD = engD.init_params((S_T,))
+            dxD, dyD = engD.shard_data(xsT, ysT)
+            report = engD.donation_report(pD, dxD, dyD, n_rounds=2)
+
+            # MFU via the one shared CostModel path.
+            samples_round = nT * nbT * bsT
+            flops_round = CostModel.analytic_train_flops(
+                module, (S_T,), samples_round
+            )
+            mfu_1 = mfu_2 = None
+            if flops_round:
+                mfu_1 = CostModel.mfu(flops_round * rps_1, n_chips=1)
+                mfu_2 = CostModel.record_round(
+                    "transformer_fed", flops_round, 1.0 / max(rps_2, 1e-9),
+                    n_chips=8,
+                )
+            hbm = {
+                dev: peak
+                for dev, _used, peak in HbmTracker().sample()
+            }
+            rel = abs(loss_2 - loss_1) / max(abs(loss_1), 1e-9)
+            extra["transformer_fed"] = {
+                "nodes": nT,
+                "seq_len": S_T,
+                "rounds_per_window": R_T,
+                "rps_1x1": round(rps_1, 3),
+                "rps_4x2": round(rps_2, 3),
+                "flops_per_round": flops_round,
+                "mfu_1x1": mfu_1,
+                "mfu_4x2": mfu_2,
+                "param_bytes_per_device_4x2": int(sharded_b),
+                "param_bytes_per_device_replicated": int(repl_b),
+                "shard_bytes_ratio": round(repl_b / max(sharded_b, 1), 3),
+                "shard_drop_ge_1_5x": bool(
+                    repl_b >= 1.5 * max(sharded_b, 1)
+                ),
+                "loss_1x1": round(loss_1, 5),
+                "loss_4x2": round(loss_2, 5),
+                "loss_parity_rel": round(rel, 5),
+                "parity_within_2pct": bool(rel <= 0.02),
+                "determinism_byte_identical": determinism,
+                "donation_clean": bool(report["clean"]),
+                "donation_report": report,
+                "hbm_peak_bytes": hbm,
+            }
+        finally:
+            Settings.restore(snap)
+    except Exception as e:
+        extra["transformer_fed_error"] = str(e)[:200]
 
 
 def _byzantine_tier(extra: dict) -> None:
@@ -2809,6 +3021,14 @@ def main() -> None:
     # (extra.async_ab / extra.async_determinism).
     if "async" in tiers:
         _async_tier(extra)
+
+    # Federated-transformer 2D-mesh tier: TransformerLM rounds/sec +
+    # MFU at 1x1 vs nodes=4 x model=2, the per-device parameter-shard
+    # drop under the SpecLayout, parity/determinism/donation booleans
+    # (extra.transformer_fed). Self-provisions 8 virtual devices in a
+    # subprocess on single-device CPU hosts, like multichip below.
+    if "transformer_fed" in tiers:
+        _transformer_fed_tier(extra)
 
     # multichip runs LAST: its 8-virtual-device subprocess and big
     # stacked allocations must not perturb the budget-sensitive

@@ -619,6 +619,9 @@ ENGINE_KEY_AXES = (
     ("bool(telemetry), ", "telemetry"),
     ("int(codec), ", "codec"),
     ("float(topk_frac),", "topk_frac"),
+    # the ISSUE-15 2D-mesh axes (SHARD_MODEL / SHARD_LAYOUT)
+    ("int(model_axes), ", "model_axes"),
+    ("str(layout),", "layout"),
 )
 
 
@@ -718,6 +721,52 @@ def test_spmd_fixture_unbound_axis_and_dead_axis_index(tmp_path):
     assert any("no enclosing shard_map" in v.message for v in found)
     root2 = _mini_repo(tmp_path / "ok", {"tpfl/ring.py": SPMD_GOOD})
     assert check_spmd(root2) == []
+
+
+def test_spmd_fixture_model_axis_names(tmp_path):
+    """ISSUE-15 satellite: the model-parallel axis names resolve
+    through the same one-hop import rule as NODE_AXIS — a psum over
+    the imported MODEL_AXIS constant passes when a PartitionSpec binds
+    it, and an UNBOUND model-axis psum fails the pass."""
+    good = """\
+        import jax
+        from jax import lax
+        from jax.sharding import PartitionSpec
+        from tpfl.parallel.compat import shard_map
+        from tpfl.parallel.mesh import MODEL_AXIS
+
+
+        def inner(x):
+            return lax.psum(x, MODEL_AXIS)
+
+
+        def outer(mesh, x):
+            spec = PartitionSpec(MODEL_AXIS)
+            fn = shard_map(inner, mesh=mesh, in_specs=(spec,),
+                           out_specs=spec)
+            return fn(x)
+    """
+    # The fixture repo needs the real constant for the one-hop
+    # resolution (the rule reads tpfl/parallel/mesh.py at the fixture
+    # root, not the live repo).
+    mesh_src = 'MODEL_AXIS = "model"\nFSDP_AXIS = "fsdp"\nTP_AXIS = "tp"\n'
+    root = _mini_repo(
+        tmp_path,
+        {"tpfl/ring2d.py": good, "tpfl/parallel/mesh.py": mesh_src},
+    )
+    assert check_spmd(root) == [], [v.render() for v in check_spmd(root)]
+    # Unbound: the enclosing shard_map binds a DIFFERENT axis, so the
+    # model-axis psum has no binding anywhere in scope.
+    bad = good.replace("spec = PartitionSpec(MODEL_AXIS)",
+                       "spec = PartitionSpec('ring')")
+    root2 = _mini_repo(
+        tmp_path / "bad",
+        {"tpfl/ring2d.py": bad, "tpfl/parallel/mesh.py": mesh_src},
+    )
+    found = check_spmd(root2)
+    assert found and "no enclosing shard_map" in found[0].message, [
+        v.render() for v in found
+    ]
 
 
 def test_spmd_fixture_axis_generic_helper(tmp_path):
@@ -876,8 +925,9 @@ def test_trace_contracts_engine_dispatch_witness(_trace_contracts):
     ys = jnp.zeros((2, 1, 4), jnp.int32)
     out = eng.run_rounds(params, xs, ys, epochs=1, donate=False)
     frac = float(Settings.WIRE_TOPK_FRAC)
-    key_false = ("plain", 1, 1, 1, False, False, 0, 0, frac)
-    key_true = ("plain", 1, 1, 1, True, False, 0, 0, frac)
+    mesh_axes = (eng.model_axes, eng.layout.name)
+    key_false = ("plain", 1, 1, 1, False, False, 0, 0, frac, *mesh_axes)
+    key_true = ("plain", 1, 1, 1, True, False, 0, 0, frac, *mesh_axes)
     assert key_false in eng._wrapped
     # The seeded key-hygiene bug: the donate=True slot serves the
     # donate=False-compiled program.

@@ -17,13 +17,17 @@ import pytest
 from tpfl.models import MLP
 from tpfl.parallel import (
     FederationEngine,
+    SpecLayout,
     VmapFederation,
     create_mesh,
+    layout_for_module,
     pad_node_axis,
     pad_node_weights,
     padded_node_count,
     sample_participants,
     shard_stacked,
+    stacked_model_shardings,
+    transformer_layout,
 )
 from tpfl.settings import Settings
 
@@ -314,6 +318,303 @@ def test_population_round_state_stays_o_active():
             lambda leaf: np.asarray(leaf[0]), eng.unpad(p)
         )
     assert all(np.isfinite(leaf).all() for leaf in _leaves(glob))
+
+
+# --- 2D nodes x model meshes (ISSUE 15) ----------------------------------
+
+
+def _lm():
+    from tpfl.models import TransformerLM
+
+    return TransformerLM(
+        vocab=64, dim=32, heads=4, n_layers=2, max_len=64,
+        compute_dtype=jnp.float32,
+    )
+
+
+def _lm_data(n, nb=1, bs=2, s=16, seed=0):
+    rng = np.random.default_rng(seed)
+    xs = rng.integers(0, 64, (n, nb, bs, s)).astype(np.int32)
+    ys = rng.integers(0, 64, (n, nb, bs, s)).astype(np.int32)
+    return xs, ys
+
+
+def _run_lm_engine(n, mesh, algorithm, xs, ys, weights, n_rounds=1, **kw):
+    eng = FederationEngine(
+        _lm(), n, mesh=mesh, seed=0, learning_rate=0.05,
+        algorithm=algorithm, **kw,
+    )
+    params = eng.init_params((xs.shape[-1],))
+    dx, dy = eng.shard_data(xs, ys)
+    if algorithm == "scaffold":
+        state = eng.init_scaffold_state(params)
+        params, _aux, state, losses = eng.run_rounds(
+            params, dx, dy, weights=weights, n_rounds=n_rounds,
+            scaffold_state=state,
+        )
+        return eng, params, losses, state
+    params, losses = eng.run_rounds(
+        params, dx, dy, weights=weights, n_rounds=n_rounds
+    )
+    return eng, params, losses, None
+
+
+@pytest.mark.parametrize("axes", [(8, 1), (4, 2), (2, 4)])
+@pytest.mark.parametrize("algorithm", ["fedavg", "scaffold"])
+def test_2d_mesh_matches_single_device(axes, algorithm):
+    """The ISSUE-15 parity matrix: nodes=8 x model=1 runs the manual
+    shard_map program (byte-identical lowering — pinned separately);
+    4x2 and 2x4 run the GSPMD layout program — all must match the
+    single-device round within accumulation tolerance, with a masked
+    (partial-participation) train set on the federated TransformerLM."""
+    n = 8
+    xs, ys = _lm_data(n)
+    w = np.asarray([1, 1, 0, 1, 0, 1, 1, 0], np.float32)
+    nodes, model = axes
+    mesh = create_mesh({"nodes": nodes, "model": model})
+    _, p1, l1, s1 = _run_lm_engine(n, None, algorithm, xs, ys, w, n_rounds=2)
+    eng, p2, l2, s2 = _run_lm_engine(n, mesh, algorithm, xs, ys, w, n_rounds=2)
+    assert eng.model_axes == model
+    for a, b in zip(_leaves(p1), _leaves(p2)):
+        np.testing.assert_allclose(a, b, atol=5e-4)
+    np.testing.assert_allclose(
+        np.asarray(l1), np.asarray(l2), atol=5e-4
+    )
+    if algorithm == "scaffold":
+        for a, b in zip(_leaves(s1), _leaves(s2)):
+            np.testing.assert_allclose(a, b, atol=5e-4)
+
+
+def test_2d_mesh_padded_and_masked_matches_unpadded():
+    """n=6 on a nodes=4 x model=2 mesh pads the NODE axis to 8 (never
+    the model axis); the real rows must match the meshless run."""
+    n = 6
+    xs, ys = _lm_data(n)
+    w = np.asarray([1, 1, 0, 1, 1, 0], np.float32)
+    mesh = create_mesh({"nodes": 4, "model": 2})
+    eng_a, p_a, _, _ = _run_lm_engine(n, None, "fedavg", xs, ys, w)
+    eng_b, p_b, _, _ = _run_lm_engine(n, mesh, "fedavg", xs, ys, w)
+    assert eng_a.padded_nodes == 6 and eng_b.padded_nodes == 8
+    for a, b in zip(_leaves(eng_a.unpad(p_a)), _leaves(eng_b.unpad(p_b))):
+        assert a.shape[0] == 6 and b.shape[0] == 6
+        np.testing.assert_allclose(a, b, atol=5e-4)
+
+
+def test_2d_mesh_per_device_param_bytes_drop():
+    """The acceptance metric: on a 4x2 mesh each device holds ~1/2 the
+    model bytes of the node-replicated layout (exact for the sharded
+    kernels/embeddings; small LayerNorm/bias leaves ride replicated)."""
+    n = 4
+    xs, ys = _lm_data(n)
+    mesh = create_mesh({"nodes": 4, "model": 2})
+    eng, p, _, _ = _run_lm_engine(n, mesh, "fedavg", xs, ys, None)
+    leaves = jax.tree_util.tree_leaves(p)
+    total = sum(leaf.nbytes for leaf in leaves)
+    per_device = sum(
+        leaf.addressable_shards[0].data.nbytes for leaf in leaves
+    )
+    # nodes axis alone gives 4x; the model axis must push well past it.
+    assert total / per_device > 4 * 1.5
+    assert any(
+        not leaf.sharding.is_fully_replicated
+        and leaf.addressable_shards[0].data.shape[1:] != leaf.shape[1:]
+        for leaf in leaves
+    )
+
+
+def test_2d_mesh_same_seed_byte_identical():
+    """Same-seed determinism at a FIXED 2D mesh shape (the mesh shape,
+    not just the device count, is the reproducibility key)."""
+    n = 8
+    xs, ys = _lm_data(n)
+    w = np.asarray([1, 0, 1, 1, 0, 1, 1, 1], np.float32)
+
+    def digest():
+        mesh = create_mesh({"nodes": 4, "model": 2})
+        _, p, _, _ = _run_lm_engine(n, mesh, "fedavg", xs, ys, w, n_rounds=2)
+        return b"".join(leaf.tobytes() for leaf in _leaves(p))
+
+    assert digest() == digest()
+
+
+def test_2d_mesh_donation_report_clean():
+    """ISSUE-15 satellite: buffer donation stays a verified contract
+    on 2D programs — every donated state leaf aliases an output in the
+    lowering AND the compiled HLO (no staging copy of the sharded
+    model state)."""
+    n = 4
+    xs, ys = _lm_data(n)
+    mesh = create_mesh({"nodes": 2, "model": 4})
+    eng = FederationEngine(_lm(), n, mesh=mesh, seed=0, learning_rate=0.05)
+    p = eng.init_params((16,))
+    dx, dy = eng.shard_data(xs, ys)
+    report = eng.donation_report(p, dx, dy, n_rounds=2)
+    assert report["clean"], report
+
+
+def test_2d_mesh_device_wire_codec_parity():
+    """ENGINE_WIRE_CODEC on a 2D mesh: the in-program quantize
+    round-trip partitions over the model shards but keeps its per-leaf
+    GLOBAL scale (max is exact under any partitioning — host-codec
+    bit semantics), so the quantized 2D run matches the quantized
+    single-device run within accumulation tolerance."""
+    n = 8
+    xs, ys = _lm_data(n)
+    snap = Settings.ENGINE_WIRE_CODEC
+    Settings.ENGINE_WIRE_CODEC = "quant8"
+    try:
+        mesh = create_mesh({"nodes": 4, "model": 2})
+        _, p1, l1, _ = _run_lm_engine(n, None, "fedavg", xs, ys, None)
+        _, p2, l2, _ = _run_lm_engine(n, mesh, "fedavg", xs, ys, None)
+        for a, b in zip(_leaves(p1), _leaves(p2)):
+            np.testing.assert_allclose(a, b, atol=5e-4)
+        np.testing.assert_allclose(
+            np.asarray(l1), np.asarray(l2), atol=5e-4
+        )
+    finally:
+        Settings.ENGINE_WIRE_CODEC = snap
+
+
+def test_2d_mesh_telemetry_carry():
+    """ENGINE_TELEMETRY on a 2D mesh: the carry fans out with sane
+    values and the model outputs stay byte-identical to the
+    untelemetered 2D program (read-only carry, as on 1D meshes)."""
+    n = 8
+    xs, ys = _lm_data(n)
+    mesh = create_mesh({"nodes": 4, "model": 2})
+    snap = Settings.ENGINE_TELEMETRY
+
+    def run(tele):
+        Settings.ENGINE_TELEMETRY = tele
+        eng = FederationEngine(
+            _lm(), n, mesh=mesh, seed=0, learning_rate=0.05
+        )
+        p = eng.init_params((16,))
+        dx, dy = eng.shard_data(xs, ys)
+        p, losses = eng.run_rounds(p, dx, dy, n_rounds=2)
+        return b"".join(leaf.tobytes() for leaf in _leaves(p))
+
+    try:
+        from tpfl.management.telemetry import metrics
+
+        off = run(False)
+        on = run(True)
+        assert off == on
+        folded = metrics.fold()
+        rounds = [
+            v for k, v in folded["counters"].items()
+            if k[0] == "tpfl_engine_rounds_total"
+        ]
+        assert rounds and sum(rounds) >= 2
+    finally:
+        Settings.ENGINE_TELEMETRY = snap
+
+
+def test_model_axis_one_mesh_lowers_byte_identical_to_1d():
+    """HLO pin: an explicit nodes=8 x model=1 mesh lowers the exact
+    manual shard_map program of the 1D nodes=8 mesh — the 2D machinery
+    engages only past model=1 (SHARD_MODEL=1 default semantics)."""
+    import hashlib
+
+    n = 8
+    xs, ys = _data(n)
+
+    def digest(mesh):
+        eng = FederationEngine(_mlp(), n, mesh=mesh, seed=0)
+        fn = eng.program(
+            "plain", 1, 2, 1, donate=False,
+            model_axes=eng.model_axes, layout=eng.layout.name,
+        )
+        p = eng.init_params((28, 28))
+        dx, dy = eng.shard_data(xs, ys)
+        low = fn.lower(p, {}, {}, {}, dx, dy, eng.pad_weights(None), eng.valid)
+        return hashlib.sha256(low.as_text().encode()).hexdigest()
+
+    assert digest(create_mesh({"nodes": 8})) == digest(
+        create_mesh({"nodes": 8, "model": 1})
+    )
+
+
+def test_auto_mesh_resolves_shard_model():
+    """SHARD_MODEL=2 over 8 devices -> a 4x2 nodes x model auto mesh;
+    a non-dividing value is an explicit error, not a silent fallback."""
+    Settings.SHARD_NODES = True
+    Settings.SHARD_DEVICES = 0
+    Settings.SHARD_MODEL = 2
+    try:
+        eng = FederationEngine(_mlp(), 8, mesh="auto", seed=0)
+        assert eng.mesh is not None
+        assert eng.mesh.shape == {"nodes": 4, "model": 2}
+        assert eng.model_axes == 2
+        Settings.SHARD_MODEL = 3
+        with pytest.raises(ValueError, match="SHARD_MODEL"):
+            FederationEngine(_mlp(), 8, mesh="auto", seed=0)
+    finally:
+        Settings.SHARD_NODES = False
+        Settings.SHARD_DEVICES = 0
+        Settings.SHARD_MODEL = 1
+
+
+def test_spec_layout_policy():
+    """The per-leaf layout policy: transformer embeddings/QKV/FFN
+    shard on the model axis, LayerNorm and non-dividing dims ride
+    replicated; MLP resolves to the replicated layout by default."""
+    lay = transformer_layout()
+    assert lay.leaf_dims(
+        "Embed_0/embedding", (64, 32), 2
+    ) == ("model", None)
+    assert lay.leaf_dims(
+        "TransformerBlock_0/Dense_0/kernel", (32, 96), 2
+    ) == (None, "model")
+    assert lay.leaf_dims(
+        "TransformerBlock_0/Dense_1/kernel", (32, 32), 2
+    ) == ("model", None)
+    assert lay.leaf_dims(
+        "TransformerBlock_0/LayerNorm_0/scale", (32,), 2
+    ) == (None,)
+    # Non-dividing named dim falls back to replicated.
+    assert lay.leaf_dims("Embed_0/embedding", (63, 32), 2) == (None, None)
+    # Axis size 1: everything replicated regardless of rules.
+    assert lay.leaf_dims("Embed_0/embedding", (64, 32), 1) == (None, None)
+    assert layout_for_module(_mlp()).name == "replicated"
+    assert layout_for_module(_lm()).name == "transformer"
+    assert isinstance(layout_for_module(_mlp(), "transformer"), SpecLayout)
+    with pytest.raises(ValueError, match="unknown model-axis layout"):
+        layout_for_module(_mlp(), "bogus")
+
+
+def test_stacked_model_shardings_specs():
+    """stacked_model_shardings prepends the node axis and applies the
+    layout's model dims per leaf."""
+    from jax.sharding import PartitionSpec
+
+    mesh = create_mesh({"nodes": 4, "model": 2})
+    tree = {
+        "Embed_0": {"embedding": np.zeros((4, 64, 32), np.float32)},
+        "LayerNorm_0": {"scale": np.zeros((4, 32), np.float32)},
+    }
+    sh = stacked_model_shardings(mesh, tree, transformer_layout())
+    assert sh["Embed_0"]["embedding"].spec == PartitionSpec(
+        "nodes", "model", None
+    )
+    assert sh["LayerNorm_0"]["scale"].spec == PartitionSpec("nodes", None)
+
+
+def test_padding_helpers_2d_aware():
+    """ISSUE-15 satellite: the padding helpers key off the NODE axis
+    size, never the device count — a 4x2 mesh pads node counts to
+    multiples of 4, and shard_stacked splits rows over nodes only."""
+    mesh = create_mesh({"nodes": 4, "model": 2})
+    assert padded_node_count(6, mesh) == 8
+    assert padded_node_count(4, mesh) == 4
+    assert padded_node_count(9, mesh) == 12
+    w = pad_node_weights(np.ones(6, np.float32), padded_node_count(6, mesh))
+    np.testing.assert_array_equal(np.asarray(w), [1, 1, 1, 1, 1, 1, 0, 0])
+    placed = shard_stacked(mesh, {"x": np.ones((6, 4), np.float32)})["x"]
+    assert placed.shape == (8, 4)
+    # Rows shard over the 4-way node axis; the model axis replicates:
+    # each of the 8 devices holds 8/4 = 2 rows, full feature width.
+    assert placed.addressable_shards[0].data.shape == (2, 4)
 
 
 # --- aux (BatchNorm) path over the mesh ----------------------------------

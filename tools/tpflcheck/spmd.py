@@ -11,8 +11,9 @@ Two JAX-semantics invariants over ``tpfl/``:
    on the untested variant it is a latent crash. Resolution:
 
    - string literals and module-level string constants resolve
-     directly (one import hop: ``NODE_AXIS`` from
-     ``tpfl.parallel.mesh``);
+     directly (one import hop: ``NODE_AXIS`` / ``MODEL_AXIS`` /
+     ``FSDP_AXIS`` / ``TP_AXIS`` from ``tpfl.parallel.mesh`` — the 2D
+     ``nodes x model`` mesh's axis names ride the same rule);
    - an axis that is a function PARAMETER is fine locally ("runs
      inside the caller's shard_map" — the inner-fn contract); the
      obligation transfers to statically-resolvable call sites

@@ -230,7 +230,12 @@ def engine_codec_roundtrip(bits: int, topk_frac: float) -> Callable:
     leaf policy: non-float and empty leaves ride dense, top-k needs
     more than one element), returning the leaf a RECEIVER would decode
     (original dtype restored). The engine vmaps this over the node
-    axis so every node quantizes its own payload."""
+    axis so every node quantizes its own payload. On 2D
+    ``nodes x model`` meshes the round-trip partitions over the model
+    shards like the rest of the round body, with the per-leaf scale
+    staying GLOBAL per leaf (the abs-max reduces exactly under any
+    partitioning) — bit-matching the host payload codec's
+    whole-leaf-scale wire format."""
     if not bits & (QUANT8 | TOPK):
         return lambda x: x
 

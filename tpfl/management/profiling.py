@@ -724,9 +724,26 @@ class CostModel:
     ) -> "int | None":
         """Per-sample forward multiply count for the zoo architectures
         (2x per mult = FLOPs). Supports the zoo ``CNN`` (3x3 SAME
-        convs + 2x2 max-pool + dense head) and ``MLP`` (dense stack);
-        returns None for architectures without an analytic model —
-        callers fall back to :meth:`xla_flops`."""
+        convs + 2x2 max-pool + dense head), ``MLP`` (dense stack) and
+        ``TransformerLM`` (per token per layer: QKV 3d² + attn-out d²
+        + FFN 2·ratio·d² mults plus causal attention ≈ S·d for the
+        score and value matmuls over ~S/2 visible keys; plus the d·V
+        logits head — the PaLM-appendix accounting, embeddings are
+        lookups); returns None for architectures without an analytic
+        model — callers fall back to :meth:`xla_flops`."""
+        vocab = getattr(module, "vocab", None)
+        t_dim = getattr(module, "dim", None)
+        t_layers = getattr(module, "n_layers", None)
+        if vocab is not None and t_dim is not None and t_layers is not None:
+            if len(input_shape) != 1:
+                return None
+            s = int(input_shape[0])
+            ratio = int(getattr(module, "mlp_ratio", 4))
+            per_token = (
+                t_layers * ((4 + 2 * ratio) * t_dim * t_dim + s * t_dim)
+                + t_dim * vocab
+            )
+            return int(s * per_token)
         channels = getattr(module, "channels", None)
         dense = getattr(module, "dense", None)
         out_channels = getattr(module, "out_channels", None)

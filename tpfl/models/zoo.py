@@ -298,6 +298,13 @@ class TransformerLM(nn.Module):
     # field: architecture metadata, not a hyperparameter).
     input_dtype = jnp.int32
 
+    # Per-leaf model-axis PartitionSpec policy for the engine's 2D
+    # ``nodes x model`` mesh (tpfl.parallel.mesh.layout_for_module):
+    # embeddings/QKV/FFN shard, LayerNorm/biases-of-row-parallel ride
+    # replicated. MLP/CNN/ResNet carry no attribute and default to
+    # the replicated layout.
+    spec_layout = "transformer"
+
     @nn.compact
     def __call__(self, tokens, train: bool = False):
         if tokens.shape[1] > self.max_len:

@@ -19,19 +19,30 @@ round-tripping through pickle on every hop. Here:
   is an exact on-device weighted reduction instead of
   gossip-until-converged.
 - :func:`create_mesh` / :func:`federation_sharding` — mesh + sharding
-  helpers for single-host (8-chip) and multi-host topologies.
+  helpers for single-host (8-chip) and multi-host topologies; 2D
+  ``nodes x model`` meshes shard each node's model over chips per a
+  :class:`SpecLayout` per-leaf PartitionSpec policy
+  (``SHARD_MODEL``/``SHARD_LAYOUT``), federating models bigger than
+  one chip's HBM (docs/scaling.md "2D mesh").
 - :class:`ShardedTrainer` — data-parallel + FSDP sharding for one large
   model across the mesh (tpfl.parallel.sharded).
 """
 
 from tpfl.parallel.mesh import (
+    MODEL_AXIS,
+    NODE_AXIS,
+    SpecLayout,
     create_mesh,
     federation_sharding,
+    global_model_shardings,
+    layout_for_module,
     pad_node_axis,
     pad_node_weights,
     padded_node_count,
     replicated,
     shard_stacked,
+    stacked_model_shardings,
+    transformer_layout,
 )
 from tpfl.parallel.engine import FederationEngine, sample_participants
 from tpfl.parallel.federation import VmapFederation
@@ -67,6 +78,13 @@ __all__ = [
     "pad_node_axis",
     "pad_node_weights",
     "shard_stacked",
+    "MODEL_AXIS",
+    "NODE_AXIS",
+    "SpecLayout",
+    "layout_for_module",
+    "transformer_layout",
+    "stacked_model_shardings",
+    "global_model_shardings",
     "FederationEngine",
     "sample_participants",
     "VmapFederation",

@@ -24,6 +24,20 @@ program; BlazeFL's bar: the fast path stays seed-deterministic):
   padded with zero-weight clone rows (``tpfl.parallel.mesh`` helpers);
   the masked-mean fold ignores w=0 entries exactly, so padding is
   numerics-free and every chip keeps an equal shard.
+- **2D nodes x model meshes** — a ``model`` axis (explicit Mesh or
+  ``Settings.SHARD_MODEL`` via ``mesh="auto"``) shards each node's
+  parameters/optimizer state over chips per a
+  :class:`~tpfl.parallel.mesh.SpecLayout` per-leaf PartitionSpec
+  policy (transformer embeddings/QKV/FFN shard; MLP/CNN leaves ride
+  replicated), so the largest federatable model is no longer one
+  chip's HBM. The 2D program is the SAME un-wrapped round body under
+  GSPMD: XLA partitions it from the layout shardings — the fold's
+  node-axis reduction still lowers to an all-reduce over ``nodes``
+  only (each model shard folds its own slice) and the layout's TP/FSDP
+  collectives ride the ``model`` axis. Transformers additionally get
+  ring-attention sequence parallelism over ``model``
+  (``sequence_parallel=True``). 1D meshes keep the manual shard_map
+  lowering byte-identical to the pre-2D engine.
 - **Device-side wire codecs** — ``Settings.ENGINE_WIRE_CODEC`` lowers
   the PR-1 payload codecs INTO the round program: each node's trained
   params pass a per-leaf int8-quantize→dequantize (and/or top-k mask)
@@ -79,14 +93,19 @@ from tpfl.learning.jax_learner import (
 from tpfl.management import profiling
 from tpfl.parallel.compat import shard_map
 from tpfl.parallel.mesh import (
+    MODEL_AXIS,
     NODE_AXIS,
+    SpecLayout,
     create_mesh,
     federation_sharding,
+    global_model_shardings,
+    layout_for_module,
     mesh_axis_size,
     pad_node_axis,
     pad_node_weights,
     padded_node_count,
     replicated,
+    stacked_model_shardings,
     valid_node_mask,
 )
 from tpfl.settings import Settings
@@ -108,9 +127,10 @@ TELEMETRY_FIELDS = TELEMETRY_NODE_FIELDS + TELEMETRY_ROUND_FIELDS
 
 # --- auto mesh resolution (Settings.SHARD_* knobs) -----------------------
 
-# unguarded: process-wide memo of immutable Mesh objects keyed by device
-# count; worst case under a race is building the same Mesh twice.
-_auto_meshes: dict[int, Mesh] = {}
+# unguarded: process-wide memo of immutable Mesh objects keyed by
+# (device count, model-axis size); worst case under a race is building
+# the same Mesh twice.
+_auto_meshes: dict[tuple[int, int], Mesh] = {}
 
 
 def shard_device_count() -> int:
@@ -122,18 +142,29 @@ def shard_device_count() -> int:
 
 
 def auto_mesh() -> Optional[Mesh]:
-    """The ``nodes`` mesh the ``SHARD_NODES`` knob selects: all allowed
-    local devices on one ``nodes`` axis, or None when sharding is off
-    or there is only one device."""
+    """The mesh the ``SHARD_NODES`` knobs select: all allowed local
+    devices on one ``nodes`` axis (``SHARD_MODEL`` = 1, the default —
+    byte-identical programs to the pre-2D path), or the 2D
+    ``nodes x model`` mesh when ``SHARD_MODEL`` = M > 1 (``nodes`` =
+    devices / M; M must divide). None when sharding is off or there is
+    only one device."""
     if not Settings.SHARD_NODES:
         return None
     d = shard_device_count()
     if d <= 1:
         return None
-    mesh = _auto_meshes.get(d)
+    m = max(1, int(Settings.SHARD_MODEL))
+    if d % m != 0:
+        raise ValueError(
+            f"SHARD_MODEL={m} does not divide the {d} allowed devices"
+        )
+    mesh = _auto_meshes.get((d, m))
     if mesh is None:
-        mesh = _auto_meshes[d] = create_mesh(
-            {NODE_AXIS: d}, devices=jax.devices()[:d]
+        axes = {NODE_AXIS: d // m}
+        if m > 1:
+            axes[MODEL_AXIS] = m
+        mesh = _auto_meshes[(d, m)] = create_mesh(
+            axes, devices=jax.devices()[:d]
         )
     return mesh
 
@@ -164,6 +195,43 @@ def sample_participants(
     return np.sort(rng.choice(population, size=k, replace=False))
 
 
+def _sequence_parallel_module(module: Any, mesh: Mesh) -> Any:
+    """Clone a transformer module onto ring attention over the 2D
+    mesh's ``model`` axis: each model shard holds one sequence block,
+    K/V rotate the ring (``tpfl.parallel.ring_attention``) — sequence
+    parallelism composed with the layout's FSDP/TP parameter sharding.
+    Modules without an unset ``attention_fn`` seam (MLP/CNN/ResNet, or
+    a transformer the caller already pinned an attention onto) pass
+    through untouched. Sequence lengths that do not divide the model
+    axis fall back to the single-device blockwise path at trace time
+    (static shapes — a Python branch, not a lowered one)."""
+    if getattr(module, "attention_fn", False) is not None:
+        return module
+    from functools import partial
+
+    from tpfl.parallel.ring_attention import (
+        blockwise_attention,
+        ring_attention,
+    )
+
+    msize = mesh_axis_size(mesh, MODEL_AXIS)
+    spec = PartitionSpec(None, MODEL_AXIS, None, None)
+
+    def model_ring_attention(q, k, v, causal: bool = True):
+        if q.shape[1] % msize != 0:
+            return blockwise_attention(q, k, v, causal=causal)
+        fn = shard_map(
+            partial(ring_attention, axis_name=MODEL_AXIS, causal=causal),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        )
+        return fn(q, k, v)
+
+    return module.clone(attention_fn=model_ring_attention)
+
+
 # --- the engine ----------------------------------------------------------
 
 
@@ -176,9 +244,25 @@ class FederationEngine:
     None (single device), or ``"auto"`` (resolve from the
     ``SHARD_NODES``/``SHARD_DEVICES`` knobs at construction).
 
-    Node-stacked state is padded to ``padded_nodes`` (a device
+    Node-stacked state is padded to ``padded_nodes`` (a NODE-axis
     multiple) with zero-weight clone rows; ``unpad`` strips them on
-    host. Losses and stacked outputs ride padded."""
+    host. Losses and stacked outputs ride padded.
+
+    2D meshes (a ``model`` axis alongside ``nodes`` — built explicitly
+    or resolved from ``SHARD_MODEL`` via ``mesh="auto"``) additionally
+    shard each node's parameters/optimizer state over ``model`` per the
+    ``layout`` per-leaf PartitionSpec policy
+    (:class:`~tpfl.parallel.mesh.SpecLayout`; None = resolve from
+    ``Settings.SHARD_LAYOUT`` / the module's declared layout): local
+    train runs FSDP/TP-sharded per node while the fold still reduces
+    over ``nodes`` only — each model shard folds its own slice. On a
+    1D mesh the engine's programs are the exact pre-2D lowering.
+
+    ``sequence_parallel`` (2D meshes, default True): a transformer
+    module whose ``attention_fn`` is unset attends via the in-tree
+    ring attention over the ``model`` axis — each model shard holds
+    one sequence block, K/V rotate the ring — whenever the sequence
+    length divides the axis (else the single-device blockwise path)."""
 
     def __init__(
         self,
@@ -192,6 +276,8 @@ class FederationEngine:
         aux_mode: str = "mean",
         algorithm: str = "fedavg",
         prox_mu: float = 0.01,
+        layout: "SpecLayout | str | None" = None,
+        sequence_parallel: bool = True,
     ) -> None:
         if aux_mode not in ("mean", "local"):
             raise ValueError(f"aux_mode must be 'mean' or 'local', got {aux_mode!r}")
@@ -202,6 +288,18 @@ class FederationEngine:
         self.module = module
         self.n_nodes = int(n_nodes)
         self.mesh = auto_mesh() if mesh == "auto" else mesh
+        #: Model-parallel axis size (1 on 1D meshes / no mesh).
+        self.model_axes = mesh_axis_size(self.mesh, MODEL_AXIS)
+        if isinstance(layout, SpecLayout):
+            self.layout = layout
+        else:
+            self.layout = layout_for_module(
+                module, layout or str(Settings.SHARD_LAYOUT)
+            )
+        if self.model_axes > 1 and sequence_parallel:
+            self.module = module = _sequence_parallel_module(
+                module, self.mesh
+            )
         self.learning_rate = float(learning_rate)
         self._opt = (optimizer_factory or default_optimizer)(learning_rate)
         self._loss_fn = loss_fn
@@ -220,6 +318,11 @@ class FederationEngine:
         self._wrapped: dict[tuple, Callable] = {}
         # unguarded: single-owner (see _programs)
         self._eval_fns: dict[bool, Callable] = {}
+        # unguarded: single-owner (see _programs) — the per-arg
+        # sharding pytrees of the most recent _prepare_args placement;
+        # the 2D program builder lowers with them so buffer donation
+        # aliases instead of freeing (see _model_mesh_shardings).
+        self._arg_shardings: Optional[tuple] = None
         # unguarded: single-owner (see _programs) — dispatch-window
         # ordinal for round-profiler attribution labels.
         self._windows = 0
@@ -234,22 +337,53 @@ class FederationEngine:
     # --- state / data placement ---
 
     def _shard(self, tree: Any) -> Any:
+        """Node-axis placement for node-stacked DATA (model-axis
+        replicated — every model shard sees the node's full batch)."""
         if self.mesh is None:
             return tree
         return jax.device_put(tree, federation_sharding(self.mesh))
 
+    def _shard_state(self, tree: Any) -> Any:
+        """Per-leaf placement for node-stacked MODEL STATE (params /
+        variates / aux): the node axis over ``nodes`` and, on a 2D
+        mesh, each leaf's model dims over ``model`` per the layout."""
+        if self.mesh is None:
+            return tree
+        if self.model_axes > 1:
+            return jax.device_put(
+                tree, stacked_model_shardings(self.mesh, tree, self.layout)
+            )
+        return jax.device_put(tree, federation_sharding(self.mesh))
+
+    def _shard_global(self, tree: Any) -> Any:
+        """Placement for UNSTACKED node-replicated state (SCAFFOLD's
+        ``c_global``): replicated over ``nodes``, layout-sharded over
+        ``model`` on a 2D mesh."""
+        if self.mesh is None:
+            return tree
+        if self.model_axes > 1:
+            return jax.device_put(
+                tree, global_model_shardings(self.mesh, tree, self.layout)
+            )
+        return jax.device_put(tree, replicated(self.mesh))
+
     def init_state(self, input_shape: tuple[int, ...]) -> tuple[Any, Any]:
         """(stacked params, stacked aux) on the padded node axis — aux
-        is ``{}`` for modules without mutable collections."""
-        dummy = jnp.zeros((1, *input_shape), jnp.float32)
+        is ``{}`` for modules without mutable collections. Token
+        modules declaring ``input_dtype`` (TransformerLM: int32 ids)
+        initialize from it, like ``create_model``."""
+        dummy = jnp.zeros(
+            (1, *input_shape),
+            getattr(self.module, "input_dtype", jnp.float32),
+        )
         variables = self.module.init(
             jax.random.PRNGKey(self.seed), dummy, train=False
         )
         params = variables["params"]
         aux = {k: v for k, v in variables.items() if k != "params"}
         return (
-            self._shard(self.broadcast_params(params)),
-            self._shard(self.broadcast_params(aux)),
+            self._shard_state(self.broadcast_params(params)),
+            self._shard_state(self.broadcast_params(aux)),
         )
 
     def init_params(self, input_shape: tuple[int, ...]) -> Any:
@@ -264,14 +398,13 @@ class FederationEngine:
 
     def init_scaffold_state(self, params: Any) -> tuple[Any, Any]:
         """(c_locals [padded, ...], c_global [...]) zero control
-        variates; c_global replicated on the mesh."""
+        variates; c_global node-replicated (model-axis sharded per the
+        layout on 2D meshes, like every other model-shaped tree)."""
         c_locals = jax.tree_util.tree_map(jnp.zeros_like, params)
         c_global = jax.tree_util.tree_map(
             lambda p: jnp.zeros(p.shape[1:], p.dtype), params
         )
-        if self.mesh is not None:
-            c_global = jax.device_put(c_global, replicated(self.mesh))
-        return self._shard(c_locals), c_global
+        return self._shard_state(c_locals), self._shard_global(c_global)
 
     def broadcast_params(self, tree: Any) -> Any:
         """One model's tree broadcast onto the padded node axis — the
@@ -595,7 +728,21 @@ class FederationEngine:
         ``compression.wire_bytes_per_model``) computed device-side."""
         local_train = self._build_local_train(kind)
         mesh = self.mesh
-        sharded = mesh is not None and mesh_axis_size(mesh) > 1
+        # Manual shard_map (per-device code, explicit psum over the
+        # node axis) on 1D node meshes — the byte-pinned pre-2D
+        # lowering. 2D nodes x model meshes take the GSPMD route
+        # instead: the SAME un-wrapped program, partitioned by XLA
+        # from the per-leaf layout shardings — the fold's einsum over
+        # the node axis still lowers to an all-reduce over ``nodes``
+        # only, with each model shard folding its own slice, and the
+        # layout's TP/FSDP collectives come from sharding propagation
+        # (the scaling-book recipe; a hand-written manual-TP body
+        # would re-derive what the partitioner already proves).
+        sharded = (
+            mesh is not None
+            and mesh_axis_size(mesh) > 1
+            and self.model_axes <= 1
+        )
         psum_axis = NODE_AXIS if sharded else None
         fold = self._build_fold(kind, psum_axis)
         codec_fn = compression.engine_codec_roundtrip(codec, topk_frac)
@@ -826,14 +973,17 @@ class FederationEngine:
     def raw_program(
         self, kind: str, epochs: int, n_rounds: int = 1, w_ndim: int = 1,
         codec: int = 0, topk_frac: float = 0.05,
+        model_axes: int = 1, layout: str = "replicated",
     ) -> Callable:
-        """Cached UNJITTED program (shard_map-wrapped on a mesh) for
-        tracing inside a caller's own jit. ``codec`` selects the
-        device-side wire-codec variant (separate cache slot — the same
-        key hygiene as the jitted programs)."""
+        """Cached UNJITTED program (shard_map-wrapped on a 1D mesh)
+        for tracing inside a caller's own jit. ``codec`` selects the
+        device-side wire-codec variant, ``model_axes``/``layout`` the
+        2D-mesh variant (separate cache slots — the same key hygiene
+        as the jitted programs; pass the engine's own
+        ``self.model_axes``/``self.layout.name``)."""
         key = (
             "raw", kind, int(epochs), int(n_rounds), int(w_ndim),
-            int(codec), float(topk_frac),
+            int(codec), float(topk_frac), int(model_axes), str(layout),
         )
         fn = self._programs.get(key)
         if fn is None:
@@ -843,10 +993,48 @@ class FederationEngine:
             )
         return fn
 
+    def _model_mesh_shardings(
+        self, w_ndim: int, telemetry: bool, a_ndim: int
+    ) -> "tuple[tuple, tuple] | tuple[None, None]":
+        """(in_shardings, out_shardings) for the 2D GSPMD program —
+        the per-leaf layout shardings of the CURRENT dispatch's placed
+        args (``_prepare_args`` stashes them; the engine is
+        single-owner, so the stash always describes the dispatch that
+        is about to fetch the program). Explicit shardings matter for
+        more than placement: buffer DONATION is resolved at lowering,
+        and a jit that only infers shardings from committed inputs
+        marks donated leaves ``jax.buffer_donor`` (freed) instead of
+        aliasing them into the outputs. (None, None) before any
+        dispatch — the inferred-sharding fallback for direct
+        ``program()`` inspection calls."""
+        in_sh = self._arg_shardings
+        if in_sh is None:
+            return None, None
+        mesh = self.mesh
+        ns = federation_sharding(mesh)
+        out_sh: tuple = (in_sh[0], in_sh[1], in_sh[2], in_sh[3], ns)
+        if telemetry:
+            rn = NamedSharding(mesh, PartitionSpec(None, NODE_AXIS))
+            rs = replicated(mesh)
+            out_sh = out_sh + (
+                {
+                    "loss": rn,
+                    "update_norm": rn,
+                    "cos_ref": rn,
+                    "delta_norm": rs,
+                    "model_norm": rs,
+                    "participation": rs,
+                    "weight_mass": rs,
+                    "wire_bytes": rs,
+                },
+            )
+        return tuple(in_sh), out_sh
+
     def _build_program(
         self, kind: str, epochs: int, n_rounds: int, w_ndim: int,
         donate: bool = True, telemetry: bool = False, a_ndim: int = 0,
         codec: int = 0, topk_frac: float = 0.05,
+        model_axes: int = 1, layout: str = "replicated",
     ) -> Callable:
         multi = self._build_multi(
             kind, epochs, n_rounds, w_ndim, telemetry, a_ndim, codec,
@@ -854,8 +1042,23 @@ class FederationEngine:
         )
         dn = (0, 1, 2, 3) if donate else ()
         mesh = self.mesh
-        if mesh is None or mesh_axis_size(mesh) <= 1:
+        if mesh is None or (
+            mesh_axis_size(mesh) <= 1 and self.model_axes <= 1
+        ):
             return jax.jit(multi, donate_argnums=dn)
+        if self.model_axes > 1:
+            # 2D nodes x model: the un-wrapped program under GSPMD —
+            # per-leaf layout shardings in and out, collectives
+            # inserted by the partitioner (see _build_multi).
+            in_sh, out_sh = self._model_mesh_shardings(
+                w_ndim, telemetry, a_ndim
+            )
+            if in_sh is None:
+                return jax.jit(multi, donate_argnums=dn)
+            return jax.jit(
+                multi, donate_argnums=dn, in_shardings=in_sh,
+                out_shardings=out_sh,
+            )
         ns = federation_sharding(mesh)
         rs = replicated(mesh)
         rn = NamedSharding(mesh, PartitionSpec(None, NODE_AXIS))
@@ -888,6 +1091,7 @@ class FederationEngine:
         self, kind: str, epochs: int, n_rounds: int = 1, w_ndim: int = 1,
         donate: bool = True, telemetry: bool = False, a_ndim: int = 0,
         codec: int = 0, topk_frac: float = 0.05,
+        model_axes: int = 1, layout: str = "replicated",
     ) -> Callable:
         """Cached compiled program for ``(kind, epochs, n_rounds,
         w_ndim)`` — the raw jitted callable (bench drives these from
@@ -903,10 +1107,14 @@ class FederationEngine:
         already-compiled program: the disabled program stays the
         byte-identical pre-telemetry (and pre-codec) lowering.
         ``topk_frac`` is in the key because top-k's ``k`` is a static
-        constant of the compiled program."""
+        constant of the compiled program. ``model_axes``/``layout``
+        (the SHARD_MODEL / SHARD_LAYOUT axes — fixed per engine, but a
+        key axis all the same, like ``donate``) split the 2D GSPMD
+        lowering from the 1D manual one."""
         key = (
             kind, int(epochs), int(n_rounds), int(w_ndim), bool(donate),
             bool(telemetry), int(a_ndim), int(codec), float(topk_frac),
+            int(model_axes), str(layout),
         )
         fn = self._programs.get(key)
         profiling.observatory.cache_event("engine_programs", hit=fn is not None)
@@ -918,15 +1126,18 @@ class FederationEngine:
         self, kind: str, epochs: int, n_rounds: int, w_ndim: int,
         donate: bool = True, telemetry: bool = False, a_ndim: int = 0,
         codec: int = 0, topk_frac: float = 0.05,
+        model_axes: int = 1, layout: str = "replicated",
     ) -> Callable:
         """The same program behind the compile observatory's recompile
         detection (keyed per (engine program, abstract shapes) like
         every other jit seam). Variant programs get their own names —
-        the telemetry/attack/codec signatures differ by construction
-        and must not read as recompile storms of the base program."""
+        the telemetry/attack/codec/2D-mesh signatures differ by
+        construction and must not read as recompile storms of the base
+        program."""
         key = (
             kind, int(epochs), int(n_rounds), int(w_ndim), bool(donate),
             bool(telemetry), int(a_ndim), int(codec), float(topk_frac),
+            int(model_axes), str(layout),
         )
         fn = self._wrapped.get(key)
         if fn is None:
@@ -934,6 +1145,7 @@ class FederationEngine:
                 (":obs" if telemetry else "")
                 + (":atk" if a_ndim else "")
                 + (f":{compression.codec_name(codec)}" if codec else "")
+                + (f":m{int(model_axes)}" if int(model_axes) > 1 else "")
             )
             wrapped = profiling.observatory.wrap(
                 self.program(*key),
@@ -953,6 +1165,8 @@ class FederationEngine:
                     "ENGINE_WIRE_CODEC": int(codec),
                     "WIRE_TOPK_FRAC": float(topk_frac),
                     "ENGINE_DONATE": bool(donate),
+                    "SHARD_MODEL": int(model_axes),
+                    "SHARD_LAYOUT": str(layout),
                 },
             )
         return fn
@@ -1011,17 +1225,21 @@ class FederationEngine:
         # hand in arrays COMMITTED as replicated on the mesh, which the
         # program's in_shardings would reject — device_put reshards
         # committed arrays where pjit refuses to. No-op (same buffer)
-        # when the sharding already matches.
-        params = self._shard(self.pad_stacked(params))
+        # when the sharding already matches. Model-state trees go
+        # through the layout-aware placement (node axis over ``nodes``,
+        # leaf model dims over ``model`` on 2D meshes); data stays
+        # node-axis-only — every model shard sees its node's full
+        # batch.
+        params = self._shard_state(self.pad_stacked(params))
         xs = self._shard(self.pad_stacked(xs))
         ys = self._shard(self.pad_stacked(ys))
         c_locals, c_global = ({}, {})
         if kind == "scaffold":
             c_locals, c_global = scaffold_state
-            c_locals = self._shard(self.pad_stacked(c_locals))
-            if self.mesh is not None:
-                c_global = jax.device_put(c_global, replicated(self.mesh))
-        a = {} if aux is None else self._shard(self.pad_stacked(aux))
+            c_locals = self._shard_state(self.pad_stacked(c_locals))
+            c_global = self._shard_global(c_global)
+        a = {} if aux is None else self._shard_state(self.pad_stacked(aux))
+        valid = self.valid
         if self.mesh is not None:
             w = jax.device_put(
                 w,
@@ -1038,9 +1256,19 @@ class FederationEngine:
                         self.mesh, PartitionSpec(None, NODE_AXIS)
                     ),
                 )
-        args = [params, c_locals, c_global, a, xs, ys, w, self.valid]
+            if self.model_axes > 1:
+                valid = jax.device_put(valid, federation_sharding(self.mesh))
+        args = [params, c_locals, c_global, a, xs, ys, w, valid]
         if scales is not None:
             args.append(scales)
+        if self.model_axes > 1:
+            # Stash the placed args' per-leaf shardings for the 2D
+            # program builder (the lowering needs them explicitly for
+            # donation aliasing — see _model_mesh_shardings).
+            self._arg_shardings = tuple(
+                jax.tree_util.tree_map(lambda x: x.sharding, arg)
+                for arg in args
+            )
         return kind, args, w, scales
 
     def donation_report(
@@ -1070,6 +1298,7 @@ class FederationEngine:
         fn = self.program(
             kind, epochs, n_rounds, w.ndim, donate=True,
             telemetry=tele_on, codec=codec, topk_frac=frac,
+            model_axes=self.model_axes, layout=self.layout.name,
         )
         return donation_analysis(fn, tuple(args))
 
@@ -1150,9 +1379,10 @@ class FederationEngine:
             donate = bool(Settings.ENGINE_DONATE)
         tele_on, codec, frac = self._resolve_variant()
         a_ndim = 0 if scales is None else int(scales.ndim)
+        model_axes, mesh_layout = self.model_axes, self.layout.name
         fn = self._wrapped_program(
             kind, epochs, n_rounds, w.ndim, donate, tele_on, a_ndim,
-            codec, frac,
+            codec, frac, model_axes, mesh_layout,
         )
         if Settings.TRACE_CONTRACTS:
             # Dispatch-time contract: the fetched program's build-time
@@ -1164,6 +1394,8 @@ class FederationEngine:
                     "ENGINE_WIRE_CODEC": int(codec),
                     "WIRE_TOPK_FRAC": float(frac),
                     "ENGINE_DONATE": bool(donate),
+                    "SHARD_MODEL": int(model_axes),
+                    "SHARD_LAYOUT": str(mesh_layout),
                 },
             )
 

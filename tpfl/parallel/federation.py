@@ -135,7 +135,10 @@ class VmapFederation:
         eng = self.engine
 
         def round_impl(params, xs, ys, weights, epochs=1):
-            fn = eng.raw_program("plain", int(epochs), 1, 1)
+            fn = eng.raw_program(
+                "plain", int(epochs), 1, 1,
+                model_axes=eng.model_axes, layout=eng.layout.name,
+            )
             p, _c, _cg, _a, losses = fn(
                 eng.pad_stacked(params), {}, {}, {},
                 eng.pad_stacked(xs), eng.pad_stacked(ys),
@@ -149,7 +152,10 @@ class VmapFederation:
         eng = self.engine
 
         def round_impl(params, aux, xs, ys, weights, epochs=1):
-            fn = eng.raw_program("aux", int(epochs), 1, 1)
+            fn = eng.raw_program(
+                "aux", int(epochs), 1, 1,
+                model_axes=eng.model_axes, layout=eng.layout.name,
+            )
             p, _c, _cg, a, losses = fn(
                 eng.pad_stacked(params), {}, {}, eng.pad_stacked(aux),
                 eng.pad_stacked(xs), eng.pad_stacked(ys),
@@ -166,7 +172,10 @@ class VmapFederation:
 
         def round_impl(params, c_locals, c_global, aux, xs, ys, weights,
                        epochs=1):
-            fn = eng.raw_program("scaffold", int(epochs), 1, 1)
+            fn = eng.raw_program(
+                "scaffold", int(epochs), 1, 1,
+                model_axes=eng.model_axes, layout=eng.layout.name,
+            )
             p, c, cg, a, losses = fn(
                 eng.pad_stacked(params), eng.pad_stacked(c_locals), c_global,
                 eng.pad_stacked(aux), eng.pad_stacked(xs),

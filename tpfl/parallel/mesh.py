@@ -5,21 +5,31 @@ annotate shardings, let XLA insert collectives. Axes used by tpfl:
 
 - ``nodes`` — the federation axis: logical FL nodes sharded over chips
   (FederationEngine / VmapFederation). Collectives over it ride ICI.
-- ``dp`` / ``fsdp`` — batch / parameter sharding inside one learner
-  (ShardedTrainer).
+- ``model`` — the model-parallel axis of the engine's 2D
+  ``nodes x model`` mesh: each node's parameters/optimizer state are
+  FSDP/TP-sharded over it per a :class:`SpecLayout` per-leaf
+  PartitionSpec policy, so one node's model may exceed one chip's HBM
+  while the federation still shards across ``nodes``. The fold's
+  reduction stays over ``nodes`` only — every model shard folds its
+  own slice.
+- ``dp`` / ``fsdp`` / ``tp`` — batch / parameter sharding inside one
+  standalone learner (ShardedTrainer).
 
 Node counts that do not divide the mesh are PADDED, not replicated:
 :func:`padded_node_count` rounds the node axis up to a multiple of the
-device count and :func:`pad_node_axis` / :func:`pad_node_weights` fill
-the tail with clone rows at zero FedAvg weight — the masked-mean fold
-already ignores w=0 entries exactly, so padding changes no numerics
-while every device keeps an equal shard. (Historically an indivisible
-node count silently fell back to a replicated single-device placement,
-throwing away the mesh.)
+mesh's NODE-axis size (never the model axis) and :func:`pad_node_axis`
+/ :func:`pad_node_weights` fill the tail with clone rows at zero
+FedAvg weight — the masked-mean fold already ignores w=0 entries
+exactly, so padding changes no numerics while every device keeps an
+equal shard. (Historically an indivisible node count silently fell
+back to a replicated single-device placement, throwing away the
+mesh.)
 """
 
 from __future__ import annotations
 
+import re
+from dataclasses import dataclass
 from typing import Any, Optional, Sequence
 
 import jax
@@ -28,6 +38,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 #: Canonical name of the federation axis.
 NODE_AXIS = "nodes"
+
+#: Canonical name of the model-parallel axis of the engine's 2D mesh.
+MODEL_AXIS = "model"
+
+#: Axis-name aliases for standalone FSDP / tensor-parallel meshes
+#: (ShardedTrainer / SpecLayout policies that split the two roles).
+FSDP_AXIS = "fsdp"
+TP_AXIS = "tp"
 
 
 def create_mesh(
@@ -81,7 +99,9 @@ def padded_node_count(
 ) -> int:
     """``n_nodes`` rounded up to a multiple of the mesh's ``axis`` size
     — the stacked leading dimension that shards evenly. Equals
-    ``n_nodes`` when there is no mesh or it already divides."""
+    ``n_nodes`` when there is no mesh or it already divides. 2D-aware
+    by construction: only the named NODE axis' size enters — a
+    ``nodes=4, model=2`` mesh pads to multiples of 4, never 8."""
     d = mesh_axis_size(mesh, axis)
     return ((int(n_nodes) + d - 1) // d) * d
 
@@ -136,7 +156,10 @@ def shard_stacked(
     """Place a node-stacked pytree on the mesh, padding the leading
     axis to a device multiple first (``n_nodes`` defaults to the first
     leaf's current leading size). With no mesh, returns the tree
-    unchanged."""
+    unchanged. On a 2D ``nodes x model`` mesh only the node axis is
+    padded and sharded — leaves ride replicated over ``model`` (use
+    :func:`stacked_model_shardings` for the per-leaf layout
+    placement)."""
     if mesh is None:
         return tree
     leaves = jax.tree_util.tree_leaves(tree)
@@ -145,3 +168,148 @@ def shard_stacked(
     n = int(n_nodes if n_nodes is not None else np.shape(leaves[0])[0])
     tree = pad_node_axis(tree, padded_node_count(n, mesh, axis))
     return jax.device_put(tree, federation_sharding(mesh, axis))
+
+
+# --- per-leaf model-axis PartitionSpec policy (SpecLayout) ----------------
+
+
+@dataclass(frozen=True)
+class SpecLayout:
+    """Canonical per-leaf PartitionSpecs for the model axis.
+
+    The 2D-mesh analogue of the fsdp/tp layout tables of large-model
+    trainers (SNIPPETS [3]): a small ordered rule list mapping flax
+    parameter PATHS (``TransformerBlock_0/Dense_2/kernel``) to the
+    model-axis dims of the leaf's PartitionSpec. The engine prepends
+    the ``nodes`` axis for node-stacked state, so a rule's dims
+    describe ONE node's (unstacked) leaf.
+
+    Rules are ``(path regex, dims)`` where ``dims`` is a tuple of
+    ``MODEL_AXIS`` / None per leaf dimension; the first rule whose
+    regex matches AND whose dims length equals the leaf's rank AND
+    whose named dims divide the mesh's model-axis size wins.
+    Unmatched leaves (and every leaf of the default empty layout) ride
+    replicated on the model axis — the MLP/CNN zoo default, which
+    keeps a 2D run numerically the plain data-parallel program."""
+
+    name: str = "replicated"
+    rules: tuple = ()
+    model_axis: str = MODEL_AXIS
+
+    def leaf_dims(
+        self, path: str, shape: Sequence[int], axis_size: int
+    ) -> tuple:
+        """Model-axis dims for one unstacked leaf at ``path`` (see
+        class docs); ``(None, ...)`` = replicated on the model axis."""
+        ndim = len(shape)
+        if axis_size > 1:
+            for pattern, dims in self.rules:
+                if len(dims) != ndim or not re.search(pattern, path):
+                    continue
+                if all(
+                    d is None or shape[i] % axis_size == 0
+                    for i, d in enumerate(dims)
+                ):
+                    return tuple(dims)
+        return (None,) * ndim
+
+    def leaf_spec(
+        self, path: str, shape: Sequence[int], axis_size: int
+    ) -> PartitionSpec:
+        """The unstacked leaf's PartitionSpec (model-axis dims only)."""
+        return PartitionSpec(*self.leaf_dims(path, shape, axis_size))
+
+
+def transformer_layout() -> SpecLayout:
+    """The TransformerLM layout: embeddings sharded over their row
+    (vocab / position) dim FSDP-style; QKV and FFN-up kernels
+    column-parallel (out-features on ``model``), attention-out and
+    FFN-down kernels row-parallel (in-features on ``model``) — the
+    Megatron pairing, so the block's collectives stay one reduce per
+    matmul pair; the logits head column-parallel over the vocab.
+    Biases of column-parallel kernels shard with their out-features;
+    LayerNorm scales/biases and everything else ride replicated."""
+    m = MODEL_AXIS
+    return SpecLayout(
+        name="transformer",
+        rules=(
+            (r"embedding$", (m, None)),
+            (r"TransformerBlock_\d+/Dense_[02]/kernel$", (None, m)),
+            (r"TransformerBlock_\d+/Dense_[13]/kernel$", (m, None)),
+            (r"TransformerBlock_\d+/Dense_[02]/bias$", (m,)),
+            (r"^Dense_\d+/kernel$", (None, m)),
+            (r"^Dense_\d+/bias$", (m,)),
+        ),
+    )
+
+
+#: Named layouts ``Settings.SHARD_LAYOUT`` / engine ``layout=`` select.
+LAYOUTS = {
+    "replicated": SpecLayout,
+    "transformer": transformer_layout,
+}
+
+
+def layout_for_module(module: Any, policy: str = "auto") -> SpecLayout:
+    """Resolve the per-leaf model-axis layout for a zoo module.
+
+    ``policy`` is a layout name from :data:`LAYOUTS`, or ``"auto"``:
+    the module's own ``spec_layout`` attribute (the zoo's transformer
+    declares ``"transformer"``), falling back to ``"replicated"`` —
+    MLP/CNN/ResNet leaves ride replicated on the model axis by
+    default."""
+    if policy == "auto":
+        policy = getattr(module, "spec_layout", "replicated") or "replicated"
+    factory = LAYOUTS.get(policy)
+    if factory is None:
+        raise ValueError(
+            f"unknown model-axis layout {policy!r}; have "
+            f"{sorted(LAYOUTS)} (or 'auto')"
+        )
+    return factory()
+
+
+def _path_str(path: tuple) -> str:
+    """``TransformerBlock_0/Dense_1/kernel`` from a tree_map_with_path
+    key path (flax DictKeys / GetAttrKeys / sequence indices)."""
+    parts = []
+    for k in path:
+        for attr in ("key", "name", "idx"):
+            v = getattr(k, attr, None)
+            if v is not None:
+                parts.append(str(v))
+                break
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def stacked_model_shardings(
+    mesh: Mesh, tree: Any, layout: SpecLayout
+) -> Any:
+    """Per-leaf NamedShardings for a NODE-STACKED state tree on a 2D
+    mesh: ``P(nodes, *layout dims)`` — the leading node axis shards
+    over ``nodes``, each node's model over ``model`` per the layout."""
+    axis_size = mesh_axis_size(mesh, layout.model_axis)
+
+    def one(path, leaf):
+        shape = tuple(np.shape(leaf))[1:]
+        dims = layout.leaf_dims(_path_str(path), shape, axis_size)
+        return NamedSharding(mesh, PartitionSpec(NODE_AXIS, *dims))
+
+    return jax.tree_util.tree_map_with_path(one, tree)
+
+
+def global_model_shardings(mesh: Mesh, tree: Any, layout: SpecLayout) -> Any:
+    """Per-leaf NamedShardings for an UNSTACKED (global, node-
+    replicated) model tree — SCAFFOLD's ``c_global``: replicated over
+    ``nodes``, sharded over ``model`` per the layout."""
+    axis_size = mesh_axis_size(mesh, layout.model_axis)
+
+    def one(path, leaf):
+        shape = tuple(np.shape(leaf))
+        return NamedSharding(
+            mesh, layout.leaf_spec(_path_str(path), shape, axis_size)
+        )
+
+    return jax.tree_util.tree_map_with_path(one, tree)

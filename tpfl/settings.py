@@ -618,6 +618,34 @@ class Settings:
     all local devices, N > 0 = the first N. Lets a multi-tenant host
     pin the federation to a slice of the chips."""
 
+    SHARD_MODEL: int = 1
+    """Model-parallel axis size of the engine's auto mesh
+    (``tpfl.parallel.engine.auto_mesh``): 1 (default) = the 1D
+    ``nodes`` mesh — engine programs lower byte-identical to the
+    pre-2D path; M > 1 = a 2D ``nodes x model`` mesh (``nodes`` =
+    allowed devices / M, which must divide) where each node's
+    parameters/optimizer state shard over the ``model`` axis per the
+    ``SHARD_LAYOUT`` per-leaf PartitionSpec policy
+    (``tpfl.parallel.mesh.SpecLayout``) — federate models bigger than
+    one chip's HBM. The fold still reduces over ``nodes`` only; each
+    model shard folds its own slice. Engines built with an explicit
+    2D ``Mesh`` ignore this knob (the mesh itself carries the axis).
+    Determinism: the full MESH SHAPE (nodes x model), not just the
+    device count, is part of the reproducibility key — see
+    docs/scaling.md."""
+
+    SHARD_LAYOUT: str = "auto"
+    """Per-leaf model-axis PartitionSpec policy for 2D meshes:
+    "auto" (default) = the module's own declared layout
+    (zoo ``TransformerLM.spec_layout`` = "transformer": embeddings /
+    QKV / FFN sharded per ``tpfl.parallel.mesh.transformer_layout``;
+    MLP/CNN/ResNet fall back to "replicated"), or a layout name from
+    ``tpfl.parallel.mesh.LAYOUTS`` to force one. "replicated" keeps
+    every leaf whole on each device — the model axis then only adds
+    redundant compute, so force it only for parity debugging.
+    Resolved at engine construction; a cache-key axis of the engine's
+    round programs like the other ENGINE_* knobs."""
+
     SHARD_ROUNDS_PER_DISPATCH: int = 1
     """Federation rounds folded into ONE device dispatch by the
     engine's ``lax.fori_loop`` round window
@@ -845,6 +873,8 @@ class Settings:
         # engine tests opt in per-case with explicit meshes/windows.
         cls.SHARD_NODES = False
         cls.SHARD_DEVICES = 0
+        cls.SHARD_MODEL = 1
+        cls.SHARD_LAYOUT = "auto"
         cls.SHARD_ROUNDS_PER_DISPATCH = 1
         # Engine-plane telemetry off by default (engine_obs tests and
         # the bench engine_obs tier toggle per-case): the elided carry
@@ -960,6 +990,8 @@ class Settings:
         # dispatch per round (reference behavior first).
         cls.SHARD_NODES = False
         cls.SHARD_DEVICES = 0
+        cls.SHARD_MODEL = 1
+        cls.SHARD_LAYOUT = "auto"
         cls.SHARD_ROUNDS_PER_DISPATCH = 1
         # Engine telemetry is an opt-in diagnostic here, like tracing/
         # profiling: enable it for engine-window runs you intend to
@@ -1127,6 +1159,14 @@ class Settings:
         # cross-window reproducibility the same way.
         cls.SHARD_NODES = True
         cls.SHARD_DEVICES = 0
+        # Model axis off by default even at scale: the zoo's bench
+        # models fit one chip, and nodes-axis throughput is the
+        # scale profile's first-order win. Raise SHARD_MODEL (a
+        # divisor of the device count) to federate models bigger
+        # than one chip's HBM; the layout then comes from the module
+        # ("auto" = zoo transformer rules, MLP/CNN replicated).
+        cls.SHARD_MODEL = 1
+        cls.SHARD_LAYOUT = "auto"
         cls.SHARD_ROUNDS_PER_DISPATCH = 8
         # At scale the engine IS the federation — without the carry an
         # 8-round window is one opaque dispatch none of the planes can
