@@ -622,6 +622,10 @@ ENGINE_KEY_AXES = (
     # the ISSUE-15 2D-mesh axes (SHARD_MODEL / SHARD_LAYOUT)
     ("int(model_axes), ", "model_axes"),
     ("str(layout),", "layout"),
+    # the ISSUE-16 fedbuff axes (async window variant + its
+    # ASYNC_STALENESS_EXP fold weighting)
+    ("bool(fedbuff), ", "fedbuff"),
+    ("float(stale_exp),", "stale_exp"),
 )
 
 
@@ -926,8 +930,14 @@ def test_trace_contracts_engine_dispatch_witness(_trace_contracts):
     out = eng.run_rounds(params, xs, ys, epochs=1, donate=False)
     frac = float(Settings.WIRE_TOPK_FRAC)
     mesh_axes = (eng.model_axes, eng.layout.name)
-    key_false = ("plain", 1, 1, 1, False, False, 0, 0, frac, *mesh_axes)
-    key_true = ("plain", 1, 1, 1, True, False, 0, 0, frac, *mesh_axes)
+    # trailing axes: the ISSUE-16 fedbuff variant + staleness exponent
+    # (False/0.0 for sync windows)
+    key_false = (
+        "plain", 1, 1, 1, False, False, 0, 0, frac, *mesh_axes, False, 0.0
+    )
+    key_true = (
+        "plain", 1, 1, 1, True, False, 0, 0, frac, *mesh_axes, False, 0.0
+    )
     assert key_false in eng._wrapped
     # The seeded key-hygiene bug: the donate=True slot serves the
     # donate=False-compiled program.

@@ -52,6 +52,7 @@ HOT_PATHS = (
     "tpfl/parallel/engine.py",
     "tpfl/parallel/federation.py",
     "tpfl/parallel/federation_learner.py",
+    "tpfl/parallel/window_pipeline.py",
     "tpfl/learning/jax_learner.py",
     "tpfl/simulation/batched_fit.py",
     "tpfl/learning/aggregators/aggregator.py",

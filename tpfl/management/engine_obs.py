@@ -82,16 +82,32 @@ def replay_window(
     peers: Optional[Sequence[str]] = None,
     wall_seconds: float = 0.0,
     dispatch_seconds: float = 0.0,
+    controller: Optional[Any] = None,
 ) -> dict:
     """Replay one window's telemetry carry into the observatory planes.
 
     ``telemetry``: the engine's carry as host numpy arrays
     (:data:`tpfl.parallel.engine.TELEMETRY_FIELDS` — per-node buffers
     ``[R, padded_nodes]``, per-round scalars ``[R]``; pad columns are
-    sliced off here). ``weights``: the window's PADDED fold weights
-    ([padded] or [R, padded]); only elected (weight > 0) nodes become
-    ledger entries — matching the gRPC tier, where only contributors
-    reach an aggregator's intake. Returns a summary
+    sliced off here). Since the free-running engine the caller starts
+    the carry's D2H copy non-blocking at DISPATCH
+    (``engine.start_host_copy``) and calls here at window finalize —
+    so this replay is pure host work that overlaps the next window's
+    device time instead of stalling the dispatch pipeline.
+    ``weights``: the window's PADDED fold weights ([padded] or
+    [R, padded]); only elected (weight > 0) nodes become ledger
+    entries — matching the gRPC tier, where only contributors reach
+    an aggregator's intake.
+
+    FedBuff windows additionally carry a per-node ``staleness`` row
+    (τ on arrival rounds, −1 in flight): election is further gated on
+    ARRIVAL, each ledger entry records its staleness ordinal (the
+    quarantine judge sees engine-tier arrivals exactly like gRPC-tier
+    ones), and — when a ``controller``
+    (:class:`~tpfl.learning.async_control.AsyncController`) is wired —
+    every round's ``(τ, stamp)`` arrival list is folded into the
+    controller's EWMA state under the serialized virtual-clock
+    discipline (stamps are round ordinals). Returns a summary
     ``{"rounds", "recorded", "flagged", "events"}``.
     """
     import numpy as np
@@ -99,6 +115,8 @@ def replay_window(
     loss = np.asarray(telemetry["loss"], np.float64)[:, :n_nodes]
     upd = np.asarray(telemetry["update_norm"], np.float64)[:, :n_nodes]
     cos = np.asarray(telemetry["cos_ref"], np.float64)[:, :n_nodes]
+    stale = telemetry.get("staleness")
+    stale = None if stale is None else np.asarray(stale, np.float64)[:, :n_nodes]
     delta = np.asarray(telemetry["delta_norm"], np.float64)
     mnorm = np.asarray(telemetry["model_norm"], np.float64)
     part = np.asarray(telemetry["participation"], np.float64)
@@ -136,6 +154,12 @@ def replay_window(
                 # everyone contributed.
                 elected = np.ones((n_nodes,), bool)
                 w_r = np.ones((n_nodes,), np.float64)
+        if stale is not None:
+            # FedBuff window: a node contributes this round only if it
+            # ARRIVED (τ >= 0; in-flight rounds carry the −1 sentinel).
+            # The schedule guarantees every round has >= 1 arrival, so
+            # no uniform fallback is needed here.
+            elected = elected & (stale[r] >= 0)
         metrics.counter("tpfl_engine_rounds_total", labels=labels)
         for i in np.flatnonzero(elected):
             metrics.observe(
@@ -162,11 +186,40 @@ def replay_window(
                     node, names[i], rnd,
                     float(upd[r, i]), float(cos[r, i]),
                     num_samples=max(1, int(round(float(w_r[i])))),
+                    staleness=(
+                        0 if stale is None
+                        else max(0, int(round(float(stale[r, i]))))
+                    ),
                 )
                 if entry is not None:
                     recorded += 1
                     if entry["flagged"]:
                         flagged += 1
+        if stale is not None:
+            arrived = np.flatnonzero(elected)
+            taus = [max(0, int(round(float(stale[r, i])))) for i in arrived]
+            if taus:
+                metrics.gauge(
+                    "tpfl_engine_staleness",
+                    float(np.mean(taus)), labels=labels,
+                )
+            if controller is not None and taus:
+                # Feed the AsyncController exactly as the gRPC
+                # aggregator does on buffer flush: one observe_round
+                # per engine round, arrivals as (τ, stamp). Stamps are
+                # deterministic round-ordinal fractions — the engine's
+                # rounds are a virtual clock (no wall time exists for
+                # device-side arrivals), and observe_round only sorts
+                # and differences them, so the spread is what matters.
+                n_arr = len(taus)
+                arrivals = [
+                    (taus[k], float(rnd) + (k + 1) / (n_arr + 1))
+                    for k in range(n_arr)
+                ]
+                controller.observe_round(
+                    rnd, arrivals, "buffer_full",
+                    float(Settings.ASYNC_ROUND_DEADLINE),
+                )
     last = n_rounds - 1
     metrics.gauge(
         "tpfl_engine_loss", float(np.mean(loss[last])), labels=labels

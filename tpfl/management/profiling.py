@@ -398,8 +398,11 @@ class RoundProfiler:
 
     def __init__(self) -> None:
         self._lock = make_lock("RoundProfiler._lock")
-        # guarded-by: _lock
-        self._active: dict[str, dict] = {}
+        # guarded-by: _lock. Per node a STACK of open round windows:
+        # the free-running engine dispatches window N+1 (opening its
+        # record) before closing window N's — overlapping windows under
+        # one node tag are the pipelined steady state, not an error.
+        self._active: dict[str, list[dict]] = {}
         # guarded-by: _lock
         self._done: deque = deque(maxlen=1024)
 
@@ -410,23 +413,43 @@ class RoundProfiler:
         if not Settings.PROFILING_ENABLED:
             return
         with self._lock:
-            self._active[node] = {
+            self._active.setdefault(node, []).append({
                 "node": node,
                 "round": round if round is not None else -1,
                 "t0": time.monotonic(),
                 "parts": dict.fromkeys(
                     ("train", "dispatch", "fold", "gossip"), 0.0
                 ),
-            }
+            })
 
-    def add(self, node: str, component: str, seconds: float) -> None:
+    def _open_record(
+        self, node: str, round: "int | None"
+    ) -> "dict | None":
+        """The node's open record for ``round`` — the most recent one
+        when ``round`` is None or unmatched (legacy single-window
+        callers never pass an ordinal). Caller holds ``_lock``."""
+        recs = self._active.get(node)
+        if not recs:
+            return None
+        if round is not None:
+            for rec in recs:
+                if rec["round"] == round:
+                    return rec
+        return recs[-1]
+
+    def add(
+        self, node: str, component: str, seconds: float,
+        round: "int | None" = None,
+    ) -> None:
         """Accumulate measured seconds into the node's OPEN round (a
         no-op outside a round window — bare learner fits in tests don't
-        need a federation round to exist)."""
+        need a federation round to exist). ``round`` disambiguates
+        when several windows are in flight (the pipelined engine);
+        None targets the most recently opened."""
         if not Settings.PROFILING_ENABLED or seconds <= 0:
             return
         with self._lock:
-            rec = self._active.get(node)
+            rec = self._open_record(node, round)
             if rec is not None:
                 parts = rec["parts"]
                 parts[component] = parts.get(component, 0.0) + seconds
@@ -441,7 +464,11 @@ class RoundProfiler:
             return None
         now = time.monotonic()
         with self._lock:
-            rec = self._active.pop(node, None)
+            rec = self._open_record(node, round)
+            if rec is not None:
+                self._active[node].remove(rec)
+                if not self._active[node]:
+                    del self._active[node]
         if rec is None:
             return None
         wall = max(now - rec["t0"], 1e-9)

@@ -44,9 +44,15 @@ from tpfl.parallel.mesh import (
     stacked_model_shardings,
     transformer_layout,
 )
-from tpfl.parallel.engine import FederationEngine, sample_participants
+from tpfl.parallel.engine import (
+    EngineWindow,
+    FederationEngine,
+    FedBuffSchedule,
+    sample_participants,
+)
 from tpfl.parallel.federation import VmapFederation
 from tpfl.parallel.federation_learner import FederationLearner
+from tpfl.parallel.window_pipeline import WindowPipeline, WindowPrefetcher
 from tpfl.parallel.moe import make_moe_layer, moe_dispatch
 from tpfl.parallel.pipeline import make_pipeline, pipeline_forward
 from tpfl.parallel.ring_attention import (
@@ -86,6 +92,10 @@ __all__ = [
     "stacked_model_shardings",
     "global_model_shardings",
     "FederationEngine",
+    "EngineWindow",
+    "FedBuffSchedule",
+    "WindowPipeline",
+    "WindowPrefetcher",
     "sample_participants",
     "VmapFederation",
     "FederationLearner",

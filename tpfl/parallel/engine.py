@@ -54,6 +54,24 @@ program; BlazeFL's bar: the fast path stays seed-deterministic):
   Disabled, the carry is ELIDED: the program lowers byte-identical to
   the pre-telemetry path (separate cache slot); enabled, model
   outputs stay byte-identical — telemetry is read-only.
+- **FedBuff async rounds** — ``run_rounds(..., schedule=...)`` runs
+  the ``fedbuff`` program variant: a seeded per-round arrival mask
+  (:class:`FedBuffSchedule`, lowered from a
+  ``TrainerSpeedPlan``-style speed skew) gates which nodes fold each
+  round, arriving contributions are staleness-weighted
+  ``w(τ) = 1/(1+τ)^ASYNC_STALENESS_EXP`` — exactly the gRPC
+  aggregator's ``staleness_weight`` — and stragglers keep their local
+  training instead of the fold broadcast, so a window no longer
+  degrades to its slowest node. With telemetry on, the carry grows a
+  per-node ``staleness`` row the observatory replays into the ledger
+  and AsyncController exactly like gRPC-tier arrivals.
+- **Free-running windows** — :meth:`FederationEngine.dispatch_window`
+  returns an :class:`EngineWindow` handle instead of blocking: the
+  outputs are JAX async futures (chainable into the next dispatch
+  while the device still runs this one) and the telemetry carry's D2H
+  copy starts non-blocking at dispatch, so ``finalize()`` — profiler
+  attribution + observatory replay — is host work that overlaps the
+  NEXT window (``tpfl.parallel.window_pipeline``, the Sebulba split).
 
 Determinism discipline: at a FIXED device count, same seed => the same
 byte-identical global model (all reductions have a fixed shape and
@@ -73,7 +91,7 @@ from __future__ import annotations
 
 import re
 import time
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -123,6 +141,11 @@ TELEMETRY_ROUND_FIELDS = (
     "wire_bytes",
 )
 TELEMETRY_FIELDS = TELEMETRY_NODE_FIELDS + TELEMETRY_ROUND_FIELDS
+#: Extra per-node carry row of the fedbuff variant: each arrival's
+#: staleness ordinal τ (−1 on rounds the node does not arrive) —
+#: what ``engine_obs.replay_window`` feeds the ledger's staleness
+#: column and the AsyncController's arrival observations.
+TELEMETRY_STALENESS_FIELD = "staleness"
 
 
 # --- auto mesh resolution (Settings.SHARD_* knobs) -----------------------
@@ -193,6 +216,267 @@ def sample_participants(
         raise ValueError(f"cannot sample {k} of {population} clients")
     rng = np.random.default_rng(np.random.SeedSequence([seed, round]))
     return np.sort(rng.choice(population, size=k, replace=False))
+
+
+class FedBuffSchedule:
+    """A per-round arrival/staleness schedule for the engine's
+    ``fedbuff`` program variant — the host-side lowering of a speed
+    plan to device-side masks.
+
+    ``arrivals`` ``[n_rounds, n_nodes]`` is the 0/1 arrival mask: a 1
+    at ``(r, i)`` means node ``i``'s buffered contribution reaches the
+    aggregator at round ``r`` (it folds, staleness-weighted, and
+    receives the broadcast); a 0 means the node is still in flight —
+    it keeps training locally and its accumulated update arrives at a
+    later round. ``taus`` ``[n_rounds, n_nodes]`` carries each
+    arrival's staleness ordinal τ (version distance since the node
+    last pulled the global model — the gRPC aggregator's definition),
+    zero on non-arrival rounds.
+
+    Built from a :class:`~tpfl.communication.faults.TrainerSpeedPlan`
+    (:meth:`from_plan`) the schedule is fully seeded: same plan, same
+    window → the same masks, byte for byte — the engine's determinism
+    discipline extends over async participation. Every round must have
+    at least one arrival (an all-zero round would silently re-enter
+    the fold's uniform fallback with semantics no async tier has).
+    """
+
+    def __init__(self, arrivals: Any, taus: Any) -> None:
+        # host-sync: schedule construction is pure host numpy — the
+        # masks exist host-side before any dispatch touches them.
+        arrivals = np.asarray(arrivals, np.float32)
+        taus = np.asarray(taus, np.float32)  # host-sync: host numpy
+        if arrivals.ndim != 2 or arrivals.shape != taus.shape:
+            raise ValueError(
+                f"arrivals/taus must be matching [n_rounds, n_nodes] "
+                f"arrays, got {arrivals.shape} vs {taus.shape}"
+            )
+        if not (arrivals.sum(axis=1) > 0).all():
+            empty = int(np.flatnonzero(arrivals.sum(axis=1) == 0)[0])
+            raise ValueError(
+                f"round {empty} of the schedule has no arrivals — every "
+                f"fedbuff round needs at least one folding node"
+            )
+        self.arrivals = arrivals
+        self.taus = taus
+        self.n_rounds, self.n_nodes = (
+            int(arrivals.shape[0]), int(arrivals.shape[1])
+        )
+
+    @classmethod
+    def from_periods(
+        cls, periods: Any, n_rounds: int, start_round: int = 0
+    ) -> "FedBuffSchedule":
+        """Periodic arrivals from per-node periods in ticks (node
+        ``i`` arrives every ``periods[i]`` rounds, first at global
+        round ``periods[i] - 1``): a period-``p`` node's contribution
+        always carries ``τ = p - 1`` — it trained from the model
+        version of its previous pull, ``p`` folds ago. ``start_round``
+        keys multi-window continuation (pass the engine's cumulative
+        round ordinal so chained windows continue one global
+        schedule)."""
+        periods = np.asarray(periods, np.int64)  # host-sync: host numpy
+        if periods.ndim != 1 or (periods < 1).any():
+            raise ValueError(f"periods must be [n] ints >= 1: {periods}")
+        g = start_round + np.arange(int(n_rounds), dtype=np.int64)[:, None]
+        arrive = ((g + 1) % periods[None, :]) == 0
+        taus = np.where(arrive, periods[None, :] - 1, 0)
+        return cls(arrive.astype(np.float32), taus.astype(np.float32))
+
+    @classmethod
+    def from_plan(
+        cls,
+        plan: Any,
+        addrs: "Sequence[str]",
+        n_rounds: int,
+        start_round: int = 0,
+        tick: "float | None" = None,
+    ) -> "FedBuffSchedule":
+        """Lower a ``TrainerSpeedPlan`` to device masks: each node's
+        delay is quantized to round ticks (``tick`` defaults to the
+        fastest node's positive delay, so the fastest nodes arrive
+        every round) and the per-node periods drive
+        :meth:`from_periods`. Deterministic: the plan's seeded delays
+        are the only randomness."""
+        delays = np.asarray(
+            [max(float(plan.delay_for(a)), 0.0) for a in addrs], np.float64
+        )
+        if tick is None:
+            positive = delays[delays > 0]
+            tick = float(positive.min()) if positive.size else 1.0
+        periods = np.maximum(
+            1, np.round(delays / max(float(tick), 1e-12)).astype(np.int64)
+        )
+        return cls.from_periods(periods, int(n_rounds), int(start_round))
+
+    def window(self, start: int, n_rounds: int) -> "FedBuffSchedule":
+        """The ``[start, start + n_rounds)`` slice as its own schedule
+        — how the :class:`~tpfl.parallel.window_pipeline.WindowPipeline`
+        carves one full-run schedule into per-dispatch windows (row
+        slicing preserves the every-round-arrives invariant)."""
+        if start < 0 or start + n_rounds > self.n_rounds:
+            raise ValueError(
+                f"window [{start}, {start + n_rounds}) outside the "
+                f"schedule's {self.n_rounds} rounds"
+            )
+        return FedBuffSchedule(
+            self.arrivals[start:start + n_rounds],
+            self.taus[start:start + n_rounds],
+        )
+
+
+def start_host_copy(tree: Any) -> None:
+    """Begin a NON-BLOCKING device→host copy of every array leaf, so a
+    later ``np.asarray`` over the tree reads host memory instead of
+    stalling the dispatch pipeline — the telemetry carry's fetch
+    starts here at dispatch and completes while the next window runs
+    (satellite of the Sebulba split; see docs/scaling.md)."""
+    for leaf in jax.tree_util.tree_leaves(tree):
+        copy = getattr(leaf, "copy_to_host_async", None)
+        if copy is not None:
+            try:
+                copy()
+            except Exception:
+                # Backends without async D2H degrade to the blocking
+                # np.asarray at finalize — correctness is unchanged.
+                pass
+
+
+class EngineWindow:
+    """One dispatched engine window in flight — the free-running seam.
+
+    JAX dispatch is asynchronous: the program call returns immediately
+    with futures for every output while the device works. This handle
+    splits :meth:`FederationEngine.run_rounds` at exactly that line:
+    :meth:`FederationEngine.dispatch_window` returns the handle with
+    the output futures (chainable straight into the next dispatch —
+    double-buffered donation: window N+1 consumes window N's output
+    buffers, which is the only copy of the state either way), and
+    :meth:`finalize` performs the window's HOST work — round-profiler
+    attribution and the telemetry fan-out
+    (``engine_obs.replay_window``) — which the
+    :class:`~tpfl.parallel.window_pipeline.WindowPipeline` runs while
+    the device executes the NEXT window. The telemetry carry's D2H
+    copy was started non-blocking at dispatch (:func:`start_host_copy`),
+    so by finalize time ``np.asarray`` reads host memory.
+
+    ``run_rounds`` is ``dispatch_window(...).finalize()`` — the
+    sequential path is the pipeline's degenerate depth-0 case, byte-
+    and side-effect-identical to the pre-pipeline engine."""
+
+    __slots__ = (
+        "_engine", "_kind", "_has_aux", "_outs", "_tele", "_w",
+        "_n_rounds", "_window_start", "_ordinal", "_prof", "_node_tag",
+        "_t0", "_t1", "_finalized", "_result",
+    )
+
+    def __init__(
+        self, engine: "FederationEngine", kind: str, has_aux: bool,
+        outs: tuple, tele: Optional[dict], w: Any, n_rounds: int,
+        window_start: int, ordinal: int, prof: bool, node_tag: str,
+        t0: float, t1: float,
+    ) -> None:
+        self._engine = engine
+        self._kind = kind
+        self._has_aux = has_aux
+        self._outs = outs
+        self._tele = tele
+        self._w = w
+        self._n_rounds = int(n_rounds)
+        self._window_start = int(window_start)
+        self._ordinal = int(ordinal)
+        self._prof = bool(prof)
+        self._node_tag = node_tag
+        self._t0 = t0
+        self._t1 = t1
+        self._finalized = False
+        self._result: Optional[tuple] = None
+
+    # --- chaining (pre-finalize): the raw output futures -----------------
+
+    @property
+    def params(self) -> Any:
+        """Stacked output params (async futures — safe to chain into
+        the next dispatch immediately)."""
+        return self._outs[0]
+
+    @property
+    def aux(self) -> Any:
+        return self._outs[3]
+
+    @property
+    def scaffold_state(self) -> tuple[Any, Any]:
+        return self._outs[1], self._outs[2]
+
+    @property
+    def losses(self) -> Any:
+        """Last round's per-node losses (padded length, futures)."""
+        return self._outs[4]
+
+    @property
+    def n_rounds(self) -> int:
+        return self._n_rounds
+
+    def wait(self) -> None:
+        """Block until the window's device work completes — the
+        pipeline's ready-timestamp probe for the device-idle-gap
+        accounting (and nothing else: finalize does the host work)."""
+        # host-sync: deliberate ready-probe — the pipeline calls this
+        # AFTER dispatching the next window, so the block measures
+        # device completion, never stalls the dispatch queue.
+        jax.block_until_ready(self._outs[4])
+
+    # --- the window's host work ------------------------------------------
+
+    def finalize(self) -> tuple:
+        """Profiler attribution + telemetry fan-out, then the caller-
+        facing result tuple (``run_rounds``' return conventions).
+        Idempotent: the host work runs once; later calls return the
+        cached tuple."""
+        if self._finalized:
+            return self._result
+        out_params, out_c, out_cg, out_aux, losses = self._outs
+        if self._prof:
+            jax.block_until_ready(losses)
+            t2 = time.monotonic()
+            # The dispatch gap is paid ONCE for the whole window — the
+            # engine's core claim, visible in tpfl_round_attr_seconds.
+            # The window ordinal targets THIS window's open profiler
+            # record: under the pipeline, window N+1's record opened
+            # (at dispatch) before window N's closes here.
+            profiling.rounds.add(self._node_tag, "dispatch",
+                                 self._t1 - self._t0, round=self._ordinal)
+            profiling.rounds.add(self._node_tag, "train", t2 - self._t1,
+                                 round=self._ordinal)
+            profiling.rounds.end_round(self._node_tag, self._ordinal)
+        if self._tele is not None:
+            # One host sync per WINDOW — and when the non-blocking D2H
+            # copy (started at dispatch) has landed, not even that:
+            # np.asarray reads the host-resident buffer.
+            from tpfl.management import engine_obs
+
+            eng = self._engine
+            host_tele = {k: np.asarray(v) for k, v in self._tele.items()}
+            engine_obs.replay_window(
+                self._node_tag,
+                profiling.module_tag(eng.module),
+                self._window_start,
+                host_tele,
+                eng.n_nodes,
+                weights=np.asarray(self._w),
+                wall_seconds=time.monotonic() - self._t0,
+                dispatch_seconds=self._t1 - self._t0,
+                controller=eng.controller,
+            )
+        if self._kind == "scaffold":
+            result: tuple = (out_params, out_aux, (out_c, out_cg), losses)
+        elif self._has_aux:
+            result = (out_params, out_aux, losses)
+        else:
+            result = (out_params, losses)
+        self._finalized = True
+        self._result = result
+        return result
 
 
 def _sequence_parallel_module(module: Any, mesh: Mesh) -> Any:
@@ -330,6 +614,12 @@ class FederationEngine:
         # run through run_rounds: the engine-plane fan-out's round
         # ordinals stay monotonic across windows.
         self._rounds_done = 0
+        #: Optional AsyncController fed by the telemetry fan-out's
+        #: staleness rows (``engine_obs.replay_window``): set it to a
+        #: node's ``state.async_controller`` and fedbuff windows drive
+        #: the same concurrency-adaptation observations as gRPC-tier
+        #: arrivals. None (default) = no feed.
+        self.controller: Optional[Any] = None
         #: [padded_nodes] 1/0 mask of real vs pad rows (the uniform
         #: fallback denominator when a round's weights are all-zero).
         self.valid = valid_node_mask(self.n_nodes, self.padded_nodes)
@@ -679,7 +969,8 @@ class FederationEngine:
     def _build_multi(
         self, kind: str, epochs: int, n_rounds: int, w_ndim: int,
         telemetry: bool = False, a_ndim: int = 0, codec: int = 0,
-        topk_frac: float = 0.05,
+        topk_frac: float = 0.05, fedbuff: bool = False,
+        stale_exp: float = 0.0,
     ) -> Callable:
         """The UNJITTED federation program (shard_map-wrapped on a
         mesh): ``fn(params, c_locals, c_global, aux, xs, ys, weights,
@@ -725,7 +1016,23 @@ class FederationEngine:
         to the pre-codec path. The telemetry carry's ``wire_bytes``
         row is the exchange's per-round tensor payload bytes
         (participating nodes × the codec's per-model bytes,
-        ``compression.wire_bytes_per_model``) computed device-side."""
+        ``compression.wire_bytes_per_model``) computed device-side.
+
+        ``fedbuff`` (the async-window variant, with ``stale_exp`` =
+        the resolved ``ASYNC_STALENESS_EXP``): appends ``arrivals``
+        and ``taus`` arguments (``[n_rounds, n]`` each, from a
+        :class:`FedBuffSchedule`). Per round, a node's fold weight
+        becomes ``w · arrive · (1+τ)^-stale_exp`` — the gRPC
+        aggregator's ``staleness_weight`` lowered on device, bit-equal
+        at τ=0 — and only ARRIVING nodes take the fold broadcast:
+        stragglers keep their locally-trained params/variates/aux (the
+        buffered-async semantics: their accumulated update arrives,
+        staleness-weighted, at a later round). ``fedbuff=False`` is
+        Python-level elision like ``telemetry=False`` — the sync
+        program lowers byte-identical to the pre-fedbuff path. With
+        telemetry, the carry grows a per-node
+        :data:`TELEMETRY_STALENESS_FIELD` row (τ on arrival rounds,
+        −1 otherwise)."""
         local_train = self._build_local_train(kind)
         mesh = self.mesh
         # Manual shard_map (per-device code, explicit psum over the
@@ -777,12 +1084,20 @@ class FederationEngine:
             return num / jnp.maximum(den, 1.0)
 
         def round_body(params, c_locals, c_global, aux, xs, ys, w, valid,
-                       scale):
+                       scale, arrive, tau):
             trained, new_c, new_aux, losses = jax.vmap(
                 lambda p, ci, a, x, y: local_train(
                     p, ci, c_global, a, x, y, epochs
                 )
             )(params, c_locals, aux, xs, ys)
+            if fedbuff:
+                # FedBuff intake: only ARRIVING nodes fold this round,
+                # each weighted by the gRPC aggregator's staleness
+                # schedule w(τ) = 1/(1+τ)^exp (aggregator.py
+                # staleness_weight — bit-equal at τ=0, where both
+                # sides produce exactly 1.0).
+                sw = (1.0 + tau) ** f32(-stale_exp)
+                w = w * arrive * sw
             if a_ndim:
                 trained = jax.tree_util.tree_map(
                     lambda t: (
@@ -813,9 +1128,35 @@ class FederationEngine:
                     "cos_ref": per_node_dot(trained, params)
                     / jnp.sqrt(jnp.maximum(t_sq * s_sq, 1e-12)),
                 }
+                if fedbuff:
+                    # τ on arrival rounds, −1 on in-flight rounds — so
+                    # the host fan-out distinguishes "arrived fresh"
+                    # (τ=0) from "did not arrive".
+                    node_stats["staleness"] = tau * arrive - (1.0 - arrive)
             out_params, out_c, out_cg, out_aux = fold(
                 trained, new_c, new_aux, c_locals, c_global, aux, w, valid
             )
+            if fedbuff:
+                # Only arrivals take the fold broadcast; stragglers
+                # keep their local training (params, variates, aux) —
+                # their buffered update folds at a later arrival.
+                got = arrive > 0
+
+                def took_fold(new, local):
+                    return jnp.where(
+                        got.reshape((-1,) + (1,) * (new.ndim - 1)),
+                        new, local,
+                    )
+
+                out_params = jax.tree_util.tree_map(
+                    took_fold, out_params, trained
+                )
+                if kind == "scaffold":
+                    out_c = jax.tree_util.tree_map(took_fold, out_c, new_c)
+                if kind != "plain":
+                    out_aux = jax.tree_util.tree_map(
+                        took_fold, out_aux, new_aux
+                    )
             if telemetry:
                 # out_params rows are IDENTICAL by construction (the
                 # fold broadcasts the aggregate to every node), so the
@@ -873,16 +1214,23 @@ class FederationEngine:
         def tele_init(n_local):
             per_node = jnp.zeros((n_rounds, n_local), f32)
             per_round = jnp.zeros((n_rounds,), f32)
-            return {
+            tele = {
                 "loss": per_node,
                 "update_norm": per_node,
                 "cos_ref": per_node,
-                "delta_norm": per_round,
-                "model_norm": per_round,
-                "participation": per_round,
-                "weight_mass": per_round,
-                "wire_bytes": per_round,
             }
+            if fedbuff:
+                tele["staleness"] = per_node
+            tele.update(
+                {
+                    "delta_norm": per_round,
+                    "model_norm": per_round,
+                    "participation": per_round,
+                    "weight_mass": per_round,
+                    "wire_bytes": per_round,
+                }
+            )
+            return tele
 
         def tele_write(tele, r, losses, node_stats, round_stats):
             tele = dict(tele)
@@ -895,18 +1243,27 @@ class FederationEngine:
 
         def multi(params, c_locals, c_global, aux, xs, ys, weights, valid,
                   *extra):
-            scales = extra[0] if a_ndim else None
+            extra = list(extra)
+            scales = extra.pop(0) if a_ndim else None
+            arrivals, taus = (
+                (extra[0], extra[1]) if fedbuff else (None, None)
+            )
 
             def scale_for(r):
                 if not a_ndim:
                     return None
                 return scales if a_ndim == 1 else scales[r]
 
+            def sched_for(r):
+                if not fedbuff:
+                    return None, None
+                return arrivals[r], taus[r]
+
             if n_rounds == 1:
                 w = weights if w_ndim == 1 else weights[0]
                 out = round_body(
                     params, c_locals, c_global, aux, xs, ys, w, valid,
-                    scale_for(0),
+                    scale_for(0), *sched_for(0),
                 )
                 if telemetry:
                     p, ci, cg, a, losses, (ns_, rs_) = out
@@ -923,7 +1280,8 @@ class FederationEngine:
                     p, ci, cg, a, _ = carry
                 w = weights if w_ndim == 1 else weights[r]
                 out = round_body(
-                    p, ci, cg, a, xs, ys, w, valid, scale_for(r)
+                    p, ci, cg, a, xs, ys, w, valid, scale_for(r),
+                    *sched_for(r),
                 )
                 if telemetry:
                     p, ci, cg, a, losses, (ns_, rs_) = out
@@ -948,20 +1306,23 @@ class FederationEngine:
         in_specs = [node, node, repl, node, node, node, w_spec, node]
         if a_ndim:
             in_specs.append(node if a_ndim == 1 else rn)
+        if fedbuff:
+            in_specs += [rn, rn]
         out_specs: tuple = (node, node, repl, node, node)
         if telemetry:
-            out_specs = out_specs + (
-                {
-                    "loss": rn,
-                    "update_norm": rn,
-                    "cos_ref": rn,
-                    "delta_norm": repl,
-                    "model_norm": repl,
-                    "participation": repl,
-                    "weight_mass": repl,
-                    "wire_bytes": repl,
-                },
-            )
+            tele_specs = {
+                "loss": rn,
+                "update_norm": rn,
+                "cos_ref": rn,
+                "delta_norm": repl,
+                "model_norm": repl,
+                "participation": repl,
+                "weight_mass": repl,
+                "wire_bytes": repl,
+            }
+            if fedbuff:
+                tele_specs["staleness"] = rn
+            out_specs = out_specs + (tele_specs,)
         return shard_map(
             multi,
             mesh=mesh,
@@ -974,27 +1335,32 @@ class FederationEngine:
         self, kind: str, epochs: int, n_rounds: int = 1, w_ndim: int = 1,
         codec: int = 0, topk_frac: float = 0.05,
         model_axes: int = 1, layout: str = "replicated",
+        fedbuff: bool = False, stale_exp: float = 0.0,
     ) -> Callable:
         """Cached UNJITTED program (shard_map-wrapped on a 1D mesh)
         for tracing inside a caller's own jit. ``codec`` selects the
         device-side wire-codec variant, ``model_axes``/``layout`` the
-        2D-mesh variant (separate cache slots — the same key hygiene
-        as the jitted programs; pass the engine's own
+        2D-mesh variant, ``fedbuff``/``stale_exp`` the async-window
+        variant (separate cache slots — the same key hygiene as the
+        jitted programs; pass the engine's own
         ``self.model_axes``/``self.layout.name``)."""
         key = (
             "raw", kind, int(epochs), int(n_rounds), int(w_ndim),
             int(codec), float(topk_frac), int(model_axes), str(layout),
+            bool(fedbuff), float(stale_exp),
         )
         fn = self._programs.get(key)
         if fn is None:
             fn = self._programs[key] = self._build_multi(
                 kind, int(epochs), int(n_rounds), int(w_ndim),
                 codec=int(codec), topk_frac=float(topk_frac),
+                fedbuff=bool(fedbuff), stale_exp=float(stale_exp),
             )
         return fn
 
     def _model_mesh_shardings(
-        self, w_ndim: int, telemetry: bool, a_ndim: int
+        self, w_ndim: int, telemetry: bool, a_ndim: int,
+        fedbuff: bool = False,
     ) -> "tuple[tuple, tuple] | tuple[None, None]":
         """(in_shardings, out_shardings) for the 2D GSPMD program —
         the per-leaf layout shardings of the CURRENT dispatch's placed
@@ -1016,18 +1382,19 @@ class FederationEngine:
         if telemetry:
             rn = NamedSharding(mesh, PartitionSpec(None, NODE_AXIS))
             rs = replicated(mesh)
-            out_sh = out_sh + (
-                {
-                    "loss": rn,
-                    "update_norm": rn,
-                    "cos_ref": rn,
-                    "delta_norm": rs,
-                    "model_norm": rs,
-                    "participation": rs,
-                    "weight_mass": rs,
-                    "wire_bytes": rs,
-                },
-            )
+            tele_sh = {
+                "loss": rn,
+                "update_norm": rn,
+                "cos_ref": rn,
+                "delta_norm": rs,
+                "model_norm": rs,
+                "participation": rs,
+                "weight_mass": rs,
+                "wire_bytes": rs,
+            }
+            if fedbuff:
+                tele_sh["staleness"] = rn
+            out_sh = out_sh + (tele_sh,)
         return tuple(in_sh), out_sh
 
     def _build_program(
@@ -1035,10 +1402,11 @@ class FederationEngine:
         donate: bool = True, telemetry: bool = False, a_ndim: int = 0,
         codec: int = 0, topk_frac: float = 0.05,
         model_axes: int = 1, layout: str = "replicated",
+        fedbuff: bool = False, stale_exp: float = 0.0,
     ) -> Callable:
         multi = self._build_multi(
             kind, epochs, n_rounds, w_ndim, telemetry, a_ndim, codec,
-            topk_frac,
+            topk_frac, fedbuff, stale_exp,
         )
         dn = (0, 1, 2, 3) if donate else ()
         mesh = self.mesh
@@ -1051,7 +1419,7 @@ class FederationEngine:
             # per-leaf layout shardings in and out, collectives
             # inserted by the partitioner (see _build_multi).
             in_sh, out_sh = self._model_mesh_shardings(
-                w_ndim, telemetry, a_ndim
+                w_ndim, telemetry, a_ndim, fedbuff
             )
             if in_sh is None:
                 return jax.jit(multi, donate_argnums=dn)
@@ -1066,20 +1434,23 @@ class FederationEngine:
         in_sh = [ns, ns, rs, ns, ns, ns, ws, ns]
         if a_ndim:
             in_sh.append(ns if a_ndim == 1 else rn)
+        if fedbuff:
+            in_sh += [rn, rn]
         out_sh: tuple = (ns, ns, rs, ns, ns)
         if telemetry:
-            out_sh = out_sh + (
-                {
-                    "loss": rn,
-                    "update_norm": rn,
-                    "cos_ref": rn,
-                    "delta_norm": rs,
-                    "model_norm": rs,
-                    "participation": rs,
-                    "weight_mass": rs,
-                    "wire_bytes": rs,
-                },
-            )
+            tele_sh = {
+                "loss": rn,
+                "update_norm": rn,
+                "cos_ref": rn,
+                "delta_norm": rs,
+                "model_norm": rs,
+                "participation": rs,
+                "weight_mass": rs,
+                "wire_bytes": rs,
+            }
+            if fedbuff:
+                tele_sh["staleness"] = rn
+            out_sh = out_sh + (tele_sh,)
         return jax.jit(
             multi,
             donate_argnums=dn,
@@ -1092,6 +1463,7 @@ class FederationEngine:
         donate: bool = True, telemetry: bool = False, a_ndim: int = 0,
         codec: int = 0, topk_frac: float = 0.05,
         model_axes: int = 1, layout: str = "replicated",
+        fedbuff: bool = False, stale_exp: float = 0.0,
     ) -> Callable:
         """Cached compiled program for ``(kind, epochs, n_rounds,
         w_ndim)`` — the raw jitted callable (bench drives these from
@@ -1110,11 +1482,16 @@ class FederationEngine:
         constant of the compiled program. ``model_axes``/``layout``
         (the SHARD_MODEL / SHARD_LAYOUT axes — fixed per engine, but a
         key axis all the same, like ``donate``) split the 2D GSPMD
-        lowering from the 1D manual one."""
+        lowering from the 1D manual one. ``fedbuff``/``stale_exp``
+        (the async-window variant and its resolved
+        ``ASYNC_STALENESS_EXP``) are key axes too: the staleness
+        exponent is a trace-time constant of the fold weighting, so
+        flipping the knob between windows must select a different
+        compiled program."""
         key = (
             kind, int(epochs), int(n_rounds), int(w_ndim), bool(donate),
             bool(telemetry), int(a_ndim), int(codec), float(topk_frac),
-            int(model_axes), str(layout),
+            int(model_axes), str(layout), bool(fedbuff), float(stale_exp),
         )
         fn = self._programs.get(key)
         profiling.observatory.cache_event("engine_programs", hit=fn is not None)
@@ -1127,17 +1504,18 @@ class FederationEngine:
         donate: bool = True, telemetry: bool = False, a_ndim: int = 0,
         codec: int = 0, topk_frac: float = 0.05,
         model_axes: int = 1, layout: str = "replicated",
+        fedbuff: bool = False, stale_exp: float = 0.0,
     ) -> Callable:
         """The same program behind the compile observatory's recompile
         detection (keyed per (engine program, abstract shapes) like
         every other jit seam). Variant programs get their own names —
-        the telemetry/attack/codec/2D-mesh signatures differ by
-        construction and must not read as recompile storms of the base
-        program."""
+        the telemetry/attack/codec/2D-mesh/fedbuff signatures differ
+        by construction and must not read as recompile storms of the
+        base program."""
         key = (
             kind, int(epochs), int(n_rounds), int(w_ndim), bool(donate),
             bool(telemetry), int(a_ndim), int(codec), float(topk_frac),
-            int(model_axes), str(layout),
+            int(model_axes), str(layout), bool(fedbuff), float(stale_exp),
         )
         fn = self._wrapped.get(key)
         if fn is None:
@@ -1146,6 +1524,7 @@ class FederationEngine:
                 + (":atk" if a_ndim else "")
                 + (f":{compression.codec_name(codec)}" if codec else "")
                 + (f":m{int(model_axes)}" if int(model_axes) > 1 else "")
+                + (":fb" if fedbuff else "")
             )
             wrapped = profiling.observatory.wrap(
                 self.program(*key),
@@ -1167,6 +1546,11 @@ class FederationEngine:
                     "ENGINE_DONATE": bool(donate),
                     "SHARD_MODEL": int(model_axes),
                     "SHARD_LAYOUT": str(layout),
+                    # 0.0 for sync programs — the dispatch side resolves
+                    # the knob to 0.0 when no schedule rides the window,
+                    # so the contract stays total without forcing the
+                    # sync path to track an async-only knob.
+                    "ASYNC_STALENESS_EXP": float(stale_exp),
                 },
             )
         return fn
@@ -1194,12 +1578,15 @@ class FederationEngine:
         aux: Optional[Any],
         scaffold_state: Optional[tuple[Any, Any]],
         attack_scales: Optional[Any],
+        schedule: Optional[FedBuffSchedule] = None,
     ) -> tuple[str, list, Any, Optional[Any]]:
         """Pad, validate and PLACE one window's inputs — the one
         argument-prep path shared by :meth:`run_rounds` and
         :meth:`donation_report`, so the donation inspection can never
         drift from the buffers the real dispatch donates. Returns
-        ``(kind, args, padded weights, padded attack scales)``."""
+        ``(kind, args, padded weights, padded attack scales)``;
+        ``schedule`` (the fedbuff variant) appends its padded
+        arrivals/taus arrays to ``args``."""
         kind = self._kind(aux)
         if kind == "scaffold" and scaffold_state is None:
             raise ValueError(
@@ -1220,6 +1607,31 @@ class FederationEngine:
                     f"per-round attack_scales have {scales.shape[0]} rows "
                     f"for {n_rounds} rounds"
                 )
+        arrivals = taus = None
+        if schedule is not None:
+            if schedule.n_rounds != n_rounds:
+                raise ValueError(
+                    f"schedule covers {schedule.n_rounds} rounds for a "
+                    f"{n_rounds}-round window"
+                )
+            if schedule.n_nodes != self.n_nodes:
+                raise ValueError(
+                    f"schedule has {schedule.n_nodes} nodes for "
+                    f"{self.n_nodes}"
+                )
+            extra = self.padded_nodes - self.n_nodes
+            # host-sync: FedBuffSchedule holds host numpy arrays (built
+            # before dispatch) — no device value is fetched here.
+            arrivals = np.asarray(schedule.arrivals, np.float32)
+            taus = np.asarray(schedule.taus, np.float32)  # host-sync: numpy
+            if extra:
+                # Pad rows never arrive (their fold weight is zero
+                # regardless) and carry zero staleness.
+                pad = np.zeros((n_rounds, extra), np.float32)
+                arrivals = np.concatenate([arrivals, pad], axis=1)
+                taus = np.concatenate([taus, pad], axis=1)
+            arrivals = jnp.asarray(arrivals)
+            taus = jnp.asarray(taus)
         # Explicit placement, not just padding: callers re-stacking from
         # a single global model (FederationLearner each protocol round)
         # hand in arrays COMMITTED as replicated on the mesh, which the
@@ -1258,9 +1670,17 @@ class FederationEngine:
                 )
             if self.model_axes > 1:
                 valid = jax.device_put(valid, federation_sharding(self.mesh))
+            if arrivals is not None:
+                rn_sh = NamedSharding(
+                    self.mesh, PartitionSpec(None, NODE_AXIS)
+                )
+                arrivals = jax.device_put(arrivals, rn_sh)
+                taus = jax.device_put(taus, rn_sh)
         args = [params, c_locals, c_global, a, xs, ys, w, valid]
         if scales is not None:
             args.append(scales)
+        if arrivals is not None:
+            args += [arrivals, taus]
         if self.model_axes > 1:
             # Stash the placed args' per-leaf shardings for the 2D
             # program builder (the lowering needs them explicitly for
@@ -1332,6 +1752,7 @@ class FederationEngine:
         scaffold_state: Optional[tuple[Any, Any]] = None,
         donate: Optional[bool] = None,
         attack_scales: Optional[Any] = None,
+        schedule: Optional[FedBuffSchedule] = None,
     ) -> tuple[Any, ...]:
         """Run ``n_rounds`` federation rounds in ONE device dispatch.
 
@@ -1366,23 +1787,67 @@ class FederationEngine:
         UNCHANGED — telemetry is read-only over the carry, and the
         model outputs stay byte-identical to the disabled program's.
 
+        ``schedule`` (a :class:`FedBuffSchedule`): run the window's
+        rounds ASYNC — per-round arrival masks gate which nodes fold,
+        arrivals are staleness-weighted
+        ``w(τ)=1/(1+τ)^ASYNC_STALENESS_EXP`` exactly like the gRPC
+        aggregator, and stragglers keep local training instead of the
+        broadcast. Seed-deterministic like everything else; None
+        (default) compiles the byte-identical sync program.
+
         Returns (params, losses) — with ``aux`` (possibly ``{}``)
         (params, aux, losses) — and for algorithm="scaffold"
         (params, aux, (c_locals, c_global), losses), matching
         ``VmapFederation.round``. ``losses`` is the LAST round's
         per-node loss vector (padded length)."""
+        return self.dispatch_window(
+            params, xs, ys, weights=weights, epochs=epochs,
+            n_rounds=n_rounds, aux=aux, scaffold_state=scaffold_state,
+            donate=donate, attack_scales=attack_scales,
+            schedule=schedule,
+        ).finalize()
+
+    def dispatch_window(
+        self,
+        params: Any,
+        xs: Any,
+        ys: Any,
+        weights: Optional[Any] = None,
+        epochs: int = 1,
+        n_rounds: int = 1,
+        aux: Optional[Any] = None,
+        scaffold_state: Optional[tuple[Any, Any]] = None,
+        donate: Optional[bool] = None,
+        attack_scales: Optional[Any] = None,
+        schedule: Optional[FedBuffSchedule] = None,
+    ) -> EngineWindow:
+        """Dispatch one window WITHOUT blocking and return the
+        :class:`EngineWindow` handle — the Sebulba split's device leg.
+        The handle's outputs are async futures chainable straight into
+        the next ``dispatch_window`` call; the host leg (profiler
+        attribution, telemetry fan-out) runs at
+        :meth:`EngineWindow.finalize`, which the pipeline overlaps
+        with the next window's device time. :meth:`run_rounds` ==
+        ``dispatch_window(...).finalize()``."""
         kind, args, w, scales = self._prepare_args(
             params, xs, ys, weights, n_rounds, aux, scaffold_state,
-            attack_scales,
+            attack_scales, schedule,
         )
         if donate is None:
             donate = bool(Settings.ENGINE_DONATE)
         tele_on, codec, frac = self._resolve_variant()
         a_ndim = 0 if scales is None else int(scales.ndim)
+        fedbuff = schedule is not None
+        # Resolved at DISPATCH (0.0 for sync windows) and threaded into
+        # the cache key: the staleness exponent is a trace-time
+        # constant of the fedbuff fold weighting.
+        stale_exp = (
+            float(Settings.ASYNC_STALENESS_EXP) if fedbuff else 0.0
+        )
         model_axes, mesh_layout = self.model_axes, self.layout.name
         fn = self._wrapped_program(
             kind, epochs, n_rounds, w.ndim, donate, tele_on, a_ndim,
-            codec, frac, model_axes, mesh_layout,
+            codec, frac, model_axes, mesh_layout, fedbuff, stale_exp,
         )
         if Settings.TRACE_CONTRACTS:
             # Dispatch-time contract: the fetched program's build-time
@@ -1396,6 +1861,7 @@ class FederationEngine:
                     "ENGINE_DONATE": bool(donate),
                     "SHARD_MODEL": int(model_axes),
                     "SHARD_LAYOUT": str(mesh_layout),
+                    "ASYNC_STALENESS_EXP": float(stale_exp),
                 },
             )
 
@@ -1414,40 +1880,21 @@ class FederationEngine:
         tele = None
         if tele_on:
             out_params, out_c, out_cg, out_aux, losses, tele = out
+            # Start the carry's device→host copy NOW, non-blocking:
+            # it lands while the device (and the host) move on, so
+            # finalize's np.asarray reads host memory instead of
+            # stalling the dispatch pipeline.
+            start_host_copy(tele)
         else:
             out_params, out_c, out_cg, out_aux, losses = out
         self._rounds_done += n_rounds
         t1 = time.monotonic() if (prof or tele_on) else 0.0
-        if prof:
-            jax.block_until_ready(losses)
-            t2 = time.monotonic()
-            # The dispatch gap is paid ONCE for the whole window — the
-            # engine's core claim, visible in tpfl_round_attr_seconds.
-            profiling.rounds.add(node_tag, "dispatch", t1 - t0)
-            profiling.rounds.add(node_tag, "train", t2 - t1)
-            profiling.rounds.end_round(node_tag, self._windows)
-        if tele is not None:
-            # One host sync per WINDOW: converting the carry blocks on
-            # the program like the profiler's block_until_ready does.
-            from tpfl.management import engine_obs
-
-            host_tele = {k: np.asarray(v) for k, v in tele.items()}
-            engine_obs.replay_window(
-                node_tag,
-                profiling.module_tag(self.module),
-                window_start,
-                host_tele,
-                self.n_nodes,
-                weights=np.asarray(w),
-                wall_seconds=time.monotonic() - t0,
-                dispatch_seconds=t1 - t0,
-            )
-
-        if kind == "scaffold":
-            return out_params, out_aux, (out_c, out_cg), losses
-        if aux is not None:
-            return out_params, out_aux, losses
-        return out_params, losses
+        return EngineWindow(
+            self, kind, aux is not None,
+            (out_params, out_c, out_cg, out_aux, losses), tele, w,
+            n_rounds, window_start, self._windows, prof, node_tag,
+            t0, t1,
+        )
 
     def _dump_flight(self, exc: Exception, kind: str, n_rounds: int) -> None:
         """Black-box the failed dispatch: an ``engine_failure`` event

@@ -270,6 +270,7 @@ class VmapFederation:
         aux: Optional[Any] = None,
         scaffold_state: Optional[tuple[Any, Any]] = None,
         donate: Optional[bool] = None,
+        schedule: Optional[Any] = None,
     ) -> tuple[Any, ...]:
         """``n_rounds`` federated rounds in ONE device dispatch (the
         engine's ``lax.fori_loop`` window — host dispatch RTT paid once
@@ -281,11 +282,15 @@ class VmapFederation:
         input buffers alive (repeated-call benchmarking over fixed
         arrays — ``profiling.best_of_wall``'s contract; the primary
         tier times the DONATING program via
-        ``profiling.best_of_wall_donated``)."""
+        ``profiling.best_of_wall_donated``). ``schedule`` (a
+        :class:`~tpfl.parallel.engine.FedBuffSchedule`) runs the
+        window ASYNC — per-round arrival masks with staleness-weighted
+        folds, the FedBuff semantics of the gRPC tier moved on-device
+        (see ``FederationEngine.run_rounds``)."""
         return self.engine.run_rounds(
             params, xs, ys, weights=weights, epochs=epochs,
             n_rounds=n_rounds, aux=aux, scaffold_state=scaffold_state,
-            donate=donate,
+            donate=donate, schedule=schedule,
         )
 
     # --- evaluation ---

@@ -75,12 +75,16 @@ class FederationLearner(Learner):
         self._train_ys: Optional[Any] = None
         self._eval_xs: Optional[Any] = None
         self._eval_ys: Optional[Any] = None
+        # Host-side (numpy) stacked train batches, cached so per-window
+        # reshuffles don't re-partition the dataset (see _window_data).
+        self._host_train: "Optional[tuple[np.ndarray, np.ndarray]]" = None
 
     # --- lazy setup ---
 
     def set_data(self, data: TpflDataset) -> None:
         super().set_data(data)
         self._train_xs = self._eval_xs = None
+        self._host_train = None
 
     def _ensure_fed(self) -> VmapFederation:
         if self._fed is None:
@@ -97,9 +101,11 @@ class FederationLearner(Learner):
             )
         return self._fed
 
-    def _stack_split(self, train: bool) -> tuple[Any, Any]:
-        """Node-stacked [N, n_batches, b, ...] arrays from this host's
-        shard, equal batch counts (truncated to the smallest partition)."""
+    def _host_stack(self, train: bool) -> tuple[np.ndarray, np.ndarray]:
+        """Node-stacked [N, n_batches, b, ...] HOST arrays from this
+        host's shard, equal batch counts (truncated to the smallest
+        partition) — the pure-numpy half of the staging, reused by the
+        per-window reshuffle."""
         parts = self.get_data().generate_partitions(
             self.n_local_nodes, self.partition_strategy, seed=self.seed
         )
@@ -116,14 +122,43 @@ class FederationLearner(Learner):
                 f"across {self.n_local_nodes} local nodes left an empty "
                 f"batch set; lower batch_size or n_local_nodes"
             )
-        xs = np.stack([x[:n_batches] for x in xs])
-        ys = np.stack([y[:n_batches] for y in ys])
-        return self._ensure_fed().shard_data(xs, ys)
+        return (
+            np.stack([x[:n_batches] for x in xs]),
+            np.stack([y[:n_batches] for y in ys]),
+        )
+
+    def _stack_split(self, train: bool) -> tuple[Any, Any]:
+        """Host stack placed on the mesh (node axis sharded)."""
+        return self._ensure_fed().shard_data(*self._host_stack(train))
 
     def _train_data(self) -> tuple[Any, Any]:
         if self._train_xs is None:
-            self._train_xs, self._train_ys = self._stack_split(train=True)
+            if self._host_train is None:
+                self._host_train = self._host_stack(train=True)
+            self._train_xs, self._train_ys = self._ensure_fed().shard_data(
+                *self._host_train
+            )
         return self._train_xs, self._train_ys
+
+    def _window_data(
+        self, widx: int, start_round: int, n_rounds: int
+    ) -> "Optional[tuple[Any, Any]]":
+        """Window ``widx``'s mesh-placed batches: the cached host stack
+        with a seeded per-window batch-order shuffle (window 0 keeps
+        the export order — the legacy single-window fit byte-exact). A
+        pure function of (seed, widx), so the sequential and pipelined
+        drivers — and the inline vs prefetch-thread stagings — produce
+        identical bytes. Runs on the prefetch thread under
+        ``ENGINE_PREFETCH``; numpy + ``device_put`` only, no dispatch."""
+        if widx == 0:
+            return self._train_data()
+        if self._host_train is None:
+            self._host_train = self._host_stack(train=True)
+        xs, ys = self._host_train
+        order = np.random.default_rng(
+            (self.seed * 1_000_003 + widx) & 0x7FFFFFFF
+        ).permutation(xs.shape[1])
+        return self._ensure_fed().shard_data(xs[:, order], ys[:, order])
 
     def _eval_data(self) -> tuple[Any, Any]:
         if self._eval_xs is None:
@@ -149,26 +184,54 @@ class FederationLearner(Learner):
 
         params = self._stack(model.get_parameters())
         aux = self._stack(model.aux_state) if model.aux_state else None
-        rounds_run = 0
         # Local rounds run in device-side windows of
         # SHARD_ROUNDS_PER_DISPATCH (engine fori_loop — one host
         # dispatch RTT per window instead of per round); interrupts are
         # honored between windows, which at the default window of 1 is
-        # exactly the legacy per-round granularity.
+        # exactly the legacy per-round granularity. Each window trains
+        # on _window_data's seeded batch order — the same pure function
+        # of (seed, window index) on both drivers below, so
+        # ENGINE_PREFETCH never changes bytes.
         window = max(1, int(Settings.SHARD_ROUNDS_PER_DISPATCH))
-        while rounds_run < self.local_rounds:
-            if self._interrupt.is_set():
-                break
-            k = min(window, self.local_rounds - rounds_run)
-            if aux is not None:
-                params, aux, _losses = fed.run_rounds(
-                    params, xs, ys, epochs=self.epochs, aux=aux, n_rounds=k
-                )
-            else:
-                params, _losses = fed.run_rounds(
-                    params, xs, ys, epochs=self.epochs, n_rounds=k
-                )
-            rounds_run += k
+        if Settings.ENGINE_PREFETCH:
+            # Free-running (Sebulba split): window N+1 is dispatched
+            # before window N's host leg runs, and the next window's
+            # batches are staged on the named prefetch thread — see
+            # tpfl.parallel.window_pipeline.
+            from tpfl.parallel.window_pipeline import WindowPipeline
+
+            result, rounds_run = WindowPipeline(fed.engine).run(
+                params, xs, ys, epochs=self.epochs,
+                n_rounds=self.local_rounds, window=window, aux=aux,
+                data_for=self._window_data,
+                should_stop=self._interrupt.is_set,
+            )
+            if rounds_run:
+                if aux is not None:
+                    params, aux, _losses = result
+                else:
+                    params, _losses = result
+        else:
+            rounds_run = 0
+            widx = 0
+            while rounds_run < self.local_rounds:
+                if self._interrupt.is_set():
+                    break
+                k = min(window, self.local_rounds - rounds_run)
+                staged = self._window_data(widx, rounds_run, k)
+                if staged is not None:
+                    xs, ys = staged
+                if aux is not None:
+                    params, aux, _losses = fed.run_rounds(
+                        params, xs, ys, epochs=self.epochs, aux=aux,
+                        n_rounds=k
+                    )
+                else:
+                    params, _losses = fed.run_rounds(
+                        params, xs, ys, epochs=self.epochs, n_rounds=k
+                    )
+                rounds_run += k
+                widx += 1
         if rounds_run == 0:
             return self.skip_fit(model)
 

@@ -1,0 +1,308 @@
+"""WindowPipeline — the free-running engine driver (Sebulba split).
+
+Podracer's Sebulba architecture (PAPERS.md) pins host work and device
+compute to SEPARATE streams and double-buffers between them. The fused
+engine already compiles K federation rounds into one device dispatch
+(:class:`~tpfl.parallel.engine.FederationEngine`), but a sequential
+driver still pays, BETWEEN windows, the host-side costs the device
+never needed to wait for: the telemetry fan-out
+(``engine_obs.replay_window``), profiler bookkeeping, next-window data
+staging, and the dispatch RTT itself.
+
+This driver exploits what JAX gives for free — async dispatch (a
+program call returns output FUTURES while the device works) and buffer
+donation (window N+1 consumes window N's output buffers in place) — to
+run the engine free:
+
+::
+
+    device |  win N  ||  win N+1  ||  win N+2  | ...
+    host   | dispatch N+1 ; finalize N (telemetry replay, profiler)
+           | stage N+2's data on the prefetch thread ; dispatch N+2 ...
+
+Steady state: the device's dispatch queue is never empty, so dispatch
+RTT and host work vanish from wall clock; the measured inter-window
+device-idle gap (:attr:`WindowPipeline.idle_gaps`, fed from the
+``jax.Array.is_ready`` probe before each dispatch) collapses to the
+argument-prep sliver — the ``engine_async`` bench tier gates the ≥2x
+cut vs sequential dispatch.
+
+Determinism: the pipeline reorders HOST work only — the device sees
+the identical program sequence over the identical buffers, so
+same-seed runs stay byte-identical to chained
+``FederationEngine.run_rounds`` calls (tests/test_engine_async.py
+proves it at 1 and 8 devices, donation report still clean).
+
+Double-buffer ownership: with donation on, window N's input state is
+consumed by the device program; the ONLY live copy of the federation
+state is window N's output futures, which this driver chains straight
+into window N+1's dispatch. At most two windows are ever in flight, so
+at most two state buffers exist — the explicit double buffer.
+
+Concurrency: the prefetch thread (:class:`WindowPrefetcher`) is a
+named, single-slot stager guarded by ``tpfl.concurrency.make_lock``
+(deadlock-ordering tracked under ``LOCK_TRACING``); it is joined at
+every take and on shutdown — no thread outlives :meth:`run`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from tpfl import concurrency
+from tpfl.management.telemetry import metrics
+from tpfl.parallel.engine import EngineWindow, FederationEngine, FedBuffSchedule
+from tpfl.settings import Settings
+
+# data_for(window_index, start_round, n_rounds) -> (xs, ys) or None
+# (None = reuse the current window's arrays).
+DataSupplier = Callable[[int, int, int], "Optional[tuple[Any, Any]]"]
+
+
+class WindowPrefetcher:
+    """Single-slot background stager for the next window's data.
+
+    One named thread per window: :meth:`start` launches it to run the
+    supplier (shuffle + ``device_put`` placement — pure host/transfer
+    work), :meth:`take` joins it and hands the staged arrays over. The
+    slot is guarded by a :func:`tpfl.concurrency.make_lock` lock, and
+    a thread is ALWAYS joined before the next starts and on
+    :meth:`close` — the pipeline leaks no threads past its run.
+    """
+
+    def __init__(
+        self, fn: DataSupplier, name: str = "tpfl-window-prefetch"
+    ) -> None:
+        self._fn = fn
+        self._name = name
+        self._lock = concurrency.make_lock("WindowPrefetcher._lock")
+        self._thread: Optional[threading.Thread] = None
+        # guarded-by: _lock — (window_index, staged_data, error)
+        self._slot: Optional[tuple] = None
+
+    def start(self, widx: int, start_round: int, n_rounds: int) -> None:
+        """Stage window ``widx``'s data in the background (joins any
+        previous stage first — one in flight)."""
+        self.close()
+
+        def work() -> None:
+            out, err = None, None
+            try:
+                out = self._fn(widx, start_round, n_rounds)
+            except BaseException as e:  # surfaced at take()
+                err = e
+            with self._lock:
+                self._slot = (widx, out, err)
+
+        self._thread = threading.Thread(
+            target=work, name=f"{self._name}[{widx}]", daemon=True
+        )
+        self._thread.start()
+
+    def take(self, widx: int) -> "Optional[tuple[Any, Any]]":
+        """Join the stage and return window ``widx``'s staged data
+        (None when nothing was staged for it); re-raises a supplier
+        error on the caller's thread."""
+        self.close()
+        with self._lock:
+            slot, self._slot = self._slot, None
+        if slot is None:
+            return None
+        staged_widx, out, err = slot
+        if err is not None:
+            raise err
+        return out if staged_widx == widx else None
+
+    def close(self) -> None:
+        """Join any in-flight stage (idempotent)."""
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join()
+
+
+def _outputs_ready(window: EngineWindow) -> bool:
+    """True when the window's device work has provably completed (the
+    non-blocking ``jax.Array.is_ready`` probe; backends without it
+    report False — unknown counts as busy, so the idle-gap accounting
+    under-reports rather than invents idleness)."""
+    probe = getattr(window.losses, "is_ready", None)
+    if probe is None:
+        return False
+    try:
+        return bool(probe())
+    except Exception:
+        return False
+
+
+class WindowPipeline:
+    """Free-running multi-window driver over one engine.
+
+    :meth:`run` covers ``n_rounds`` federation rounds in windows of
+    ``window`` rounds each, keeping one window in flight ahead of the
+    host: window N+1 is DISPATCHED before window N is FINALIZED, so
+    the telemetry fan-out, profiler rows and next-window data staging
+    all overlap device compute. Results, side effects and bytes match
+    a sequential chain of :meth:`FederationEngine.run_rounds` calls
+    over the same per-window data.
+
+    Attributes:
+        idle_gaps: measured device-idle gap (seconds) before each
+            dispatch after the first — the time the device's queue sat
+            provably empty while the host prepared the next window
+            (see :func:`_outputs_ready`). The ``engine_async`` bench
+            tier compares these against the sequential driver's gaps.
+        windows_run: dispatched window count from the last :meth:`run`.
+    """
+
+    def __init__(self, engine: FederationEngine) -> None:
+        self.engine = engine
+        self.idle_gaps: list[float] = []
+        self.windows_run = 0
+
+    def run(
+        self,
+        params: Any,
+        xs: Any,
+        ys: Any,
+        weights: Optional[Any] = None,
+        epochs: int = 1,
+        n_rounds: int = 1,
+        window: Optional[int] = None,
+        aux: Optional[Any] = None,
+        scaffold_state: Optional[tuple[Any, Any]] = None,
+        donate: Optional[bool] = None,
+        schedule: Optional[FedBuffSchedule] = None,
+        data_for: Optional[DataSupplier] = None,
+        prefetch: Optional[bool] = None,
+        should_stop: Optional[Callable[[], bool]] = None,
+    ) -> tuple[Optional[tuple], int]:
+        """Run ``n_rounds`` rounds free-running; returns
+        ``(result, rounds_done)`` where ``result`` follows
+        ``run_rounds``' return conventions for the LAST window (None
+        if ``should_stop`` fired before the first dispatch).
+
+        ``window`` (rounds per dispatch) defaults to
+        ``Settings.SHARD_ROUNDS_PER_DISPATCH``. ``schedule`` spans the
+        FULL run and is carved into per-window slices
+        (:meth:`FedBuffSchedule.window`); per-round ``weights``
+        ``[n_rounds, n]`` are sliced the same way. ``data_for``
+        supplies each window's (possibly reshuffled, mesh-placed) data
+        — staged on the :class:`WindowPrefetcher` thread when
+        ``prefetch`` (default ``Settings.ENGINE_PREFETCH``) is on, or
+        inline otherwise; both stagings are the same pure function of
+        the window index, so the knob never changes bytes.
+        ``should_stop`` is polled between dispatches (interrupt
+        honoring at exactly the sequential driver's granularity)."""
+        eng = self.engine
+        window = max(
+            1,
+            int(
+                window
+                if window is not None
+                else Settings.SHARD_ROUNDS_PER_DISPATCH
+            ),
+        )
+        if prefetch is None:
+            prefetch = bool(Settings.ENGINE_PREFETCH)
+        if schedule is not None and schedule.n_rounds != int(n_rounds):
+            raise ValueError(
+                f"schedule covers {schedule.n_rounds} rounds for a "
+                f"{n_rounds}-round run"
+            )
+        w = None if weights is None else weights
+        per_round_w = getattr(w, "ndim", 1) == 2
+        scaffold = scaffold_state is not None
+        has_aux = aux is not None
+
+        prefetcher = (
+            WindowPrefetcher(data_for)
+            if (prefetch and data_for is not None)
+            else None
+        )
+        self.idle_gaps = []
+        self.windows_run = 0
+        pending: Optional[EngineWindow] = None
+        result: Optional[tuple] = None
+        done = 0
+        widx = 0
+        cur_xs, cur_ys = xs, ys
+        try:
+            while done < int(n_rounds):
+                if should_stop is not None and should_stop():
+                    break
+                k = min(window, int(n_rounds) - done)
+                # This window's data: taken from the prefetch thread
+                # (staged while the previous window ran) or computed
+                # inline — same supplier, same bytes.
+                if data_for is not None:
+                    staged = (
+                        prefetcher.take(widx)
+                        if (prefetcher is not None and widx > 0)
+                        else data_for(widx, done, k)
+                    )
+                    if staged is not None:
+                        cur_xs, cur_ys = staged
+                idle_probe = pending is not None and _outputs_ready(pending)
+                t_probe = time.monotonic()
+                handle = eng.dispatch_window(
+                    params,
+                    cur_xs,
+                    cur_ys,
+                    weights=(w[done:done + k] if per_round_w else w),
+                    epochs=epochs,
+                    n_rounds=k,
+                    aux=aux,
+                    scaffold_state=scaffold_state,
+                    donate=donate,
+                    schedule=(
+                        None if schedule is None else schedule.window(done, k)
+                    ),
+                )
+                t_disp = time.monotonic()
+                if pending is not None:
+                    # Idle-gap accounting: if the previous window's
+                    # outputs were ALREADY ready before we started
+                    # building this dispatch, the device queue sat
+                    # empty at least for the prep sliver we just
+                    # measured; otherwise the queue never drained.
+                    self.idle_gaps.append(
+                        (t_disp - t_probe) if idle_probe else 0.0
+                    )
+                # Stage the NEXT window's data while the device works
+                # and before this host thread dives into finalize.
+                nxt = done + k
+                if prefetcher is not None and nxt < int(n_rounds):
+                    prefetcher.start(
+                        widx + 1, nxt, min(window, int(n_rounds) - nxt)
+                    )
+                if pending is not None:
+                    # Window N's host leg (telemetry replay, profiler
+                    # rows) overlaps window N+1's device leg.
+                    result = pending.finalize()
+                # Chain the output futures straight into the next
+                # dispatch — the double buffer: with donation on these
+                # are the only live copy of the federation state.
+                params = handle.params
+                if scaffold:
+                    aux = handle.aux
+                    scaffold_state = handle.scaffold_state
+                elif has_aux:
+                    aux = handle.aux
+                pending = handle
+                done += k
+                widx += 1
+                self.windows_run += 1
+        finally:
+            if prefetcher is not None:
+                prefetcher.close()
+            if pending is not None:
+                result = pending.finalize()
+        if self.idle_gaps:
+            metrics.gauge(
+                "tpfl_engine_idle_gap_seconds",
+                float(sum(self.idle_gaps) / len(self.idle_gaps)),
+                labels={"driver": "pipeline"},
+            )
+        return result, done

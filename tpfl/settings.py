@@ -702,6 +702,22 @@ class Settings:
     rides ``WIRE_TOPK_FRAC``. See docs/scaling.md "Device-side wire
     codecs"."""
 
+    ENGINE_PREFETCH: bool = False
+    """Free-running engine windows in ``FederationLearner.fit``
+    (tpfl.parallel.window_pipeline): when on, local rounds run through
+    the :class:`~tpfl.parallel.window_pipeline.WindowPipeline` — window
+    N+1 is dispatched before window N's host leg (telemetry fan-out,
+    profiler rows) runs, and the next window's shuffled batch staging
+    (``device_put`` placement included) happens on a named background
+    prefetch thread, so dispatch RTT and host work overlap device
+    compute instead of sitting between windows (the Sebulba split,
+    docs/scaling.md "Free-running windows"). PERF-ONLY by
+    construction: the device sees the identical program sequence over
+    identical buffers, so same-seed fits are byte-identical with the
+    knob on or off; interrupts stay window-granular; the prefetch
+    thread is joined before fit returns. Off (default): the sequential
+    window loop. Read per fit() call."""
+
     ENGINE_DONATE: bool = True
     """Default donation mode for the engine's round program
     (``FederationEngine.run_rounds(donate=None)``): True donates the
@@ -888,6 +904,10 @@ class Settings:
         # identity vs donate=False).
         cls.ENGINE_WIRE_CODEC = "dense"
         cls.ENGINE_DONATE = True
+        # Sequential windows by default in tests — the pipelined path
+        # is byte-identical (test_engine_async pins it) but interleaves
+        # host work, which single-stepping tests don't want.
+        cls.ENGINE_PREFETCH = False
 
     @classmethod
     def set_standalone_settings(cls) -> None:
@@ -1002,6 +1022,9 @@ class Settings:
         # stays on.
         cls.ENGINE_WIRE_CODEC = "dense"
         cls.ENGINE_DONATE = True
+        # Interactive single-host runs: the free-running driver only
+        # helps once windows carry real work; opt in per-experiment.
+        cls.ENGINE_PREFETCH = False
 
     @classmethod
     def set_scale_settings(cls) -> None:
@@ -1181,6 +1204,11 @@ class Settings:
         # parity). Donation on: O(1)-model HBM per window.
         cls.ENGINE_WIRE_CODEC = "quant8"
         cls.ENGINE_DONATE = True
+        # 8-round windows carry enough device work to hide the host
+        # legs behind — free-running is the point of this profile:
+        # dispatch RTT, telemetry fan-out and batch staging all
+        # overlap device compute (byte-identical either way).
+        cls.ENGINE_PREFETCH = True
 
     @classmethod
     def snapshot(cls) -> dict[str, Any]:
