@@ -860,7 +860,7 @@ TIERS = (
     "primary", "resnet", "attention", "transformer", "sim1000",
     "multichip", "wire", "serde", "chaos", "analysis", "telemetry",
     "profiling", "ledger", "byzantine", "async", "engine_obs",
-    "engine_wire", "engine_async", "transformer_fed",
+    "engine_wire", "engine_async", "elastic", "transformer_fed",
 )
 
 
@@ -1839,6 +1839,336 @@ def _engine_async_tier(extra: dict) -> None:
             Settings.restore(snap)
     except Exception as e:
         extra["engine_async_error"] = str(e)[:200]
+
+
+def _elastic_tier(extra: dict) -> None:
+    """Elastic engine tier (ISSUE 17: zero-recompile membership churn
+    + kill-and-resume checkpointing). Four receipts:
+
+    - extra.elastic_storm: a 20-event join/leave/crash/quarantine/
+      readmit storm over 30 engine rounds through a ``MembershipView``.
+      Gates: every engine program holds exactly ONE compile signature
+      (churn inside a tier is a weight-mask edit — the
+      CompileObservatory is the receipt), and the total compile count
+      beyond the initial program equals the view's tier promotions
+      (recompiles == promotions, nothing else).
+    - extra.elastic_masked: an elastic capacity-8 run with 4 live
+      members vs a fresh-compiled exact-size n=4 run on the same
+      8-device ``nodes`` mesh — live rows byte-identical (the masked
+      program IS the exact program over identical inputs). Runs in an
+      8-forced-virtual-device subprocess on single-device CPU hosts
+      (``TPFL_ELASTIC_SUB``), like the multichip tier.
+    - extra.elastic_resume: kill-and-resume equivalence — 3 rounds, an
+      ``EngineCheckpointer`` round trip through disk, 3 more rounds on
+      a FRESH engine vs 6 uninterrupted: byte-identical, plus the
+      sha256 digest of the final model bytes.
+    - extra.elastic_snapshot: cadence-checkpoint overhead — the same
+      pipelined run with and without ``snapshot_every`` (snapshots ride
+      the non-blocking host copy off the dispatch path). Gate: ≤ 5%
+      wall overhead.
+    """
+    import hashlib
+    import os
+    import tempfile
+    import time
+
+    import jax
+    import numpy as np
+
+    from tpfl.management import profiling
+    from tpfl.management.checkpoint import EngineCheckpointer
+    from tpfl.models import MLP
+    from tpfl.parallel import (
+        FederationEngine,
+        WindowPipeline,
+        create_mesh,
+    )
+    from tpfl.parallel.membership import MembershipView
+    from tpfl.settings import Settings
+
+    def tree_bytes(tree):
+        return b"".join(
+            np.asarray(leaf).tobytes()
+            for leaf in jax.tree_util.tree_leaves(tree)
+        )
+
+    def data(n, nb=1, bs=32, seed=13):
+        rng = np.random.default_rng(seed)
+        xs = rng.random((n, nb, bs, 28, 28), np.float32)
+        ys = rng.integers(0, 10, (n, nb, bs)).astype(np.int32)
+        return xs, ys
+
+    def engine(n, mesh=None):
+        return FederationEngine(
+            MLP(hidden_sizes=(64,)), n, mesh=mesh,
+            learning_rate=0.1, seed=0,
+        )
+
+    def masked_receipt(mesh8):
+        """Elastic capacity-8 (4 live) vs exact n=4 on the same mesh:
+        both pad to 8 rows (row-0 clones at zero weight), so the
+        inputs — and therefore the outputs — are bitwise identical."""
+        n_live = 4
+        xs, ys = data(n_live)
+        exact = engine(n_live, mesh=mesh8)
+        p = exact.init_params((28, 28))
+        dx, dy = exact.shard_data(xs, ys)
+        out_exact, _ = exact.run_rounds(p, dx, dy, n_rounds=2,
+                                        donate=False)
+        view = MembershipView(
+            [f"n{i}" for i in range(n_live)], capacity_min=8
+        )
+        el = engine(8, mesh=mesh8)
+        el.attach_membership(view)
+
+        def pad(a):
+            return np.concatenate(
+                [a, np.broadcast_to(a[:1], (4, *a.shape[1:]))]
+            )
+
+        dx8, dy8 = el.shard_data(pad(xs), pad(ys))
+        p8 = el.pad_stacked(exact.unpad(p))
+        out_el, _ = el.run_rounds(p8, dx8, dy8, weights=view.weights(),
+                                  n_rounds=2, donate=False)
+
+        def live(t):
+            return jax.tree_util.tree_map(
+                lambda x: np.asarray(x)[:n_live], t
+            )
+
+        return bool(tree_bytes(live(out_el)) == tree_bytes(live(out_exact)))
+
+    try:
+        snap = Settings.snapshot()
+        try:
+            Settings.set_test_settings()
+            Settings.from_env()
+
+            if os.environ.get("TPFL_ELASTIC_SUB"):
+                # Subprocess leg: ONLY the 8-virtual-device masked
+                # receipt.
+                mesh8 = create_mesh({"nodes": 8})
+                extra["elastic_masked"] = {
+                    "byte_identical": masked_receipt(mesh8),
+                    "devices": 8,
+                }
+                return
+
+            # (a) Churn storm: 20 membership events over 30 rounds,
+            # one engine, the observatory counting every compile.
+            events = [
+                ("leave", "n1"), ("join", "n1"), ("crash", "n2"),
+                ("join", "n2"), ("quarantine", "n3"), ("readmit", "n3"),
+                ("leave", "n0"), ("join", "n0"), ("quarantine", "n1"),
+                ("readmit", "n1"), ("crash", "n3"), ("join", "n3"),
+                ("leave", "n2"), ("join", "n2"), ("quarantine", "n0"),
+                ("readmit", "n0"),
+                ("join", "n4"),  # slot 5 of 4: the ONE promotion
+                ("leave", "n4"), ("join", "n4"), ("quarantine", "n4"),
+            ]
+            R_STORM = 30
+            view = MembershipView(
+                [f"n{i}" for i in range(4)], capacity_min=4
+            )
+            eng = engine(4)
+            eng.attach_membership(view)
+            p = eng.init_params((28, 28))
+            xs_full, ys_full = data(8)
+            dx, dy = eng.shard_data(xs_full[:4], ys_full[:4])
+            Settings.PROFILING_ENABLED = True
+            profiling.observatory.reset()
+            for r in range(R_STORM):
+                if r < len(events):
+                    kind, addr = events[r]
+                    getattr(view, kind)(addr)
+                u = eng.unpad(p)
+                if eng.sync_membership():
+                    # Tier boundary: re-pad state/data at the new
+                    # capacity — the one churn event that compiles.
+                    p = eng.pad_stacked(u)
+                    dx, dy = eng.shard_data(
+                        xs_full[: eng.n_nodes], ys_full[: eng.n_nodes]
+                    )
+                p, _ = eng.run_rounds(
+                    p, dx, dy, weights=view.weights(), n_rounds=1,
+                    donate=False,
+                )
+            counts = {
+                k: v
+                for k, v in profiling.observatory.signature_counts().items()
+                if k.startswith("engine_round")
+            }
+            Settings.PROFILING_ENABLED = False
+            compiles = int(sum(counts.values()))
+            promotions = view.promotions()
+            extra["elastic_storm"] = {
+                "events": len(events),
+                "rounds": R_STORM,
+                "programs": counts,
+                "promotions": promotions,
+                "zero_recompiles": bool(
+                    counts and all(v == 1 for v in counts.values())
+                ),
+                "recompiles_equal_promotions": bool(
+                    compiles - 1 == promotions
+                ),
+                "tier_events": view.tier_events(),
+            }
+
+            # (b) Masked-vs-exact byte identity (needs 8 devices for
+            # matched padded sizes).
+            if jax.device_count() >= 8:
+                mesh8 = create_mesh(
+                    {"nodes": 8}, devices=jax.devices()[:8]
+                )
+                extra["elastic_masked"] = {
+                    "byte_identical": masked_receipt(mesh8),
+                    "devices": 8,
+                }
+            elif jax.default_backend() == "cpu":
+                # Single-device CPU host: force 8 virtual devices in a
+                # subprocess (the multichip-tier discipline).
+                import json as _json
+                import subprocess
+                import sys as _sys
+
+                env = dict(
+                    os.environ,
+                    JAX_PLATFORMS="cpu",
+                    TPFL_ELASTIC_SUB="1",
+                    XLA_FLAGS=(
+                        os.environ.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8"
+                    ).strip(),
+                )
+                proc = subprocess.run(
+                    [
+                        _sys.executable,
+                        os.path.abspath(__file__),
+                        "--tiers",
+                        "elastic",
+                    ],
+                    capture_output=True, text=True, env=env,
+                    timeout=1200,
+                )
+                sub = _json.loads(proc.stdout.splitlines()[-1])
+                masked = sub["extra"].get("elastic_masked", {})
+                extra["elastic_masked"] = {
+                    "byte_identical": bool(
+                        masked.get("byte_identical", False)
+                    ),
+                    "devices": 8,
+                    "subprocess": True,
+                }
+            else:
+                extra["elastic_masked"] = {
+                    "skipped": "needs >= 8 devices for matched padding"
+                }
+
+            # (c) Kill-and-resume equivalence digest: 3 + (disk round
+            # trip) + 3 rounds on a FRESH engine vs 6 uninterrupted.
+            nR = 4
+            xsR, ysR = data(nR)
+            eng_a = engine(nR)
+            pa = eng_a.init_params((28, 28))
+            dxa, dya = eng_a.shard_data(xsR, ysR)
+            pa, _ = eng_a.run_rounds(pa, dxa, dya, n_rounds=6,
+                                     donate=False)
+            eng_b = engine(nR)
+            pb = eng_b.init_params((28, 28))
+            dxb, dyb = eng_b.shard_data(xsR, ysR)
+            pb, _ = eng_b.run_rounds(pb, dxb, dyb, n_rounds=3,
+                                     donate=False)
+            with tempfile.TemporaryDirectory() as td:
+                ck = EngineCheckpointer(td, node="bench")
+                ck.save(eng_b.export_state(pb), step=3)
+                state, meta = ck.restore()
+            eng_c = engine(nR)
+            out = eng_c.import_state(state)
+            dxc, dyc = eng_c.shard_data(xsR, ysR)
+            pc, _ = eng_c.run_rounds(out["params"], dxc, dyc,
+                                     n_rounds=3, donate=False)
+            b_full = tree_bytes(eng_a.unpad(pa))
+            b_res = tree_bytes(eng_c.unpad(pc))
+            extra["elastic_resume"] = {
+                "rounds": 6,
+                "resume_at": int(meta["step"]),
+                "byte_identical": bool(b_full == b_res),
+                "digest": hashlib.sha256(b_full).hexdigest()[:16],
+                "resumed_digest": hashlib.sha256(b_res).hexdigest()[:16],
+            }
+
+            # (d) Snapshot overhead: same engine, same program, same
+            # windows — with vs without the cadence checkpoint.
+            nS, RS, WS, EP, EVERY = 16, 24, 2, 8, 4
+            # Batches sized so a rep runs seconds, not milliseconds:
+            # host-timing jitter and the fixed per-snapshot cost must
+            # both be small against the round compute they ride.
+            xsS, ysS = data(nS, nb=2, bs=96)
+            eng_s = engine(nS)
+            p_s = eng_s.init_params((28, 28))
+            dxs, dys = eng_s.shard_data(xsS, ysS)
+
+            def run_once(snap_every=0, snap_to=None, drain=None):
+                pipe = WindowPipeline(eng_s)
+                t0 = time.monotonic()
+                result, done = pipe.run(
+                    p_s, dxs, dys, epochs=EP, n_rounds=RS, window=WS,
+                    donate=False, snapshot_every=snap_every,
+                    snapshot_to=snap_to,
+                )
+                jax.block_until_ready(result[0])
+                if drain is not None:
+                    drain()  # published-to-disk before the clock stops
+                assert done == RS
+                return time.monotonic() - t0
+
+            from concurrent.futures import ThreadPoolExecutor
+
+            with tempfile.TemporaryDirectory() as td, \
+                    ThreadPoolExecutor(max_workers=1) as pool:
+                ck = EngineCheckpointer(td, node="bench")
+                # The snapshot callback gets freshly-materialized host
+                # numpy (the pipeline's non-blocking copy), so the
+                # serialize+publish rides a worker thread off the
+                # dispatch path — XLA's compute doesn't hold the GIL,
+                # so the write overlaps the next window's rounds.
+                pending = []
+
+                def save(r, s):
+                    pending.append(pool.submit(ck.save, s, step=r))
+
+                def drain():
+                    for f in pending:
+                        f.result()
+                    pending.clear()
+
+                run_once()  # warm: compile the window program
+                run_once(EVERY, save, drain)  # warm serialize/write
+                # Interleave the reps (plain, snap, plain, snap, ...)
+                # and take mins: host-load drift during the tier hits
+                # both legs instead of biasing the ratio.
+                t_p, t_s = [], []
+                for _ in range(4):
+                    t_p.append(run_once())
+                    t_s.append(run_once(EVERY, save, drain))
+                t_plain, t_snap = min(t_p), min(t_s)
+                published = ck.latest_step()
+            overhead = t_snap / max(t_plain, 1e-9) - 1.0
+            extra["elastic_snapshot"] = {
+                "rounds": RS,
+                "window": WS,
+                "snapshot_every": EVERY,
+                "snapshots_published_to_round": published,
+                "plain_s": round(t_plain, 4),
+                "snapshot_s": round(t_snap, 4),
+                "overhead": round(overhead, 4),
+                "within_5pct_budget": bool(overhead <= 0.05),
+            }
+        finally:
+            Settings.restore(snap)
+    except Exception as e:
+        extra["elastic_error"] = str(e)[:200]
 
 
 def _transformer_fed_tier(extra: dict) -> None:
@@ -3280,6 +3610,16 @@ def main() -> None:
     # the 8-device leg in a subprocess on single-device CPU hosts.
     if "engine_async" in tiers:
         _engine_async_tier(extra)
+
+    # Elastic engine tier: 20-event membership churn storm with the
+    # CompileObservatory's recompiles == promotions receipt, masked-vs-
+    # exact byte identity at matched padded sizes, the kill-and-resume
+    # equivalence digest, and the cadence-snapshot ≤5% overhead budget
+    # (extra.elastic_storm / elastic_masked / elastic_resume /
+    # elastic_snapshot). Self-provisions the 8-device masked leg in a
+    # subprocess on single-device CPU hosts.
+    if "elastic" in tiers:
+        _elastic_tier(extra)
 
     # Async tier: FedBuff-style buffered rounds vs the synchronous
     # barrier under a 10x-skewed trainer fleet, plus the serialized

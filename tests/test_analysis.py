@@ -626,6 +626,11 @@ ENGINE_KEY_AXES = (
     # ASYNC_STALENESS_EXP fold weighting)
     ("bool(fedbuff), ", "fedbuff"),
     ("float(stale_exp),", "stale_exp"),
+    # the ISSUE-17 elastic axes (capacity tier + restore-mesh shape):
+    # a tier promotion or a restore onto another mesh must select its
+    # own cache slot, never replay a stale-shaped program
+    ("int(capacity), ", "capacity"),
+    ("int(mesh_nodes),", "mesh_nodes"),
 )
 
 
@@ -931,12 +936,18 @@ def test_trace_contracts_engine_dispatch_witness(_trace_contracts):
     frac = float(Settings.WIRE_TOPK_FRAC)
     mesh_axes = (eng.model_axes, eng.layout.name)
     # trailing axes: the ISSUE-16 fedbuff variant + staleness exponent
-    # (False/0.0 for sync windows)
+    # (False/0.0 for sync windows), then the ISSUE-17 elastic axes
+    # (capacity tier, mesh node-axis size)
+    from tpfl.parallel.mesh import mesh_axis_size
+
+    elastic_axes = (int(eng.padded_nodes), mesh_axis_size(eng.mesh))
     key_false = (
-        "plain", 1, 1, 1, False, False, 0, 0, frac, *mesh_axes, False, 0.0
+        "plain", 1, 1, 1, False, False, 0, 0, frac, *mesh_axes,
+        False, 0.0, *elastic_axes,
     )
     key_true = (
-        "plain", 1, 1, 1, True, False, 0, 0, frac, *mesh_axes, False, 0.0
+        "plain", 1, 1, 1, True, False, 0, 0, frac, *mesh_axes,
+        False, 0.0, *elastic_axes,
     )
     assert key_false in eng._wrapped
     # The seeded key-hygiene bug: the donate=True slot serves the

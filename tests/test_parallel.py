@@ -244,8 +244,10 @@ def test_vmap_federation_fedprox_pulls_toward_anchor():
 
     def dist(fed):
         params = fed.init_params((28, 28))
-        # Snapshot before round() donates the buffers.
-        p0 = [np.asarray(leaf) for leaf in jax.tree_util.tree_leaves(params)]
+        # Snapshot before round() donates the buffers — np.array, not
+        # np.asarray: asarray is a zero-copy VIEW of the CPU device
+        # buffer, which an in-place donating executable overwrites.
+        p0 = [np.array(leaf) for leaf in jax.tree_util.tree_leaves(params)]
         xs, ys = _node_data(2, n_batches=2, bs=8)
         out, _ = fed.round(params, jnp.asarray(xs), jnp.asarray(ys))
         sq = 0.0
@@ -321,7 +323,9 @@ def test_vmap_federation_batchnorm_round():
     assert "batch_stats" in aux
     xs, ys = _node_data(n, n_batches=2, bs=8)
     xs, ys = fed.shard_data(xs, ys)
-    aux0 = jax.tree_util.tree_map(np.asarray, aux)
+    # Owning snapshot (np.array): round() donates aux, and np.asarray
+    # is a zero-copy view of the donated CPU buffer.
+    aux0 = jax.tree_util.tree_map(np.array, aux)
 
     new_params, new_aux, losses = fed.round(params, xs, ys, epochs=1, aux=aux)
     assert losses.shape == (n,)
@@ -404,7 +408,9 @@ def test_fedbn_mask_keeps_nonparticipant_stats():
     params, aux = fed.init_state((28, 28))
     xs, ys = _node_data(n, n_batches=2, bs=8)
     weights = jnp.asarray([1.0, 1.0, 0.0, 0.0])
-    aux0 = jax.tree_util.tree_map(np.asarray, aux)
+    # Owning snapshot (np.array): round() donates aux, and np.asarray
+    # is a zero-copy view of the donated CPU buffer.
+    aux0 = jax.tree_util.tree_map(np.array, aux)
     _, new_aux, _ = fed.round(
         params, jnp.asarray(xs), jnp.asarray(ys), weights=weights, aux=aux
     )

@@ -74,6 +74,9 @@ GUARDED_MODULES = (
     "tpfl/attacks/attacks.py",
     "tpfl/attacks/plan.py",
     "tpfl/parallel/engine.py",
+    "tpfl/parallel/membership.py",
+    "tpfl/parallel/window_pipeline.py",
+    "tpfl/management/checkpoint.py",
 )
 
 _GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)(\s+writes)?")

@@ -228,6 +228,16 @@ class FaultInjector:
 
         tracing.event("crash_injected", addr)
         flight.dump(addr, "crash")
+        # A crashed node's in-flight engine window must not keep
+        # running (leaked prefetch thread, unreferenced donated
+        # buffers) — reach the pipeline's abort seam directly, same as
+        # Node.stop does on the graceful path.
+        try:
+            from tpfl.parallel import window_pipeline
+
+            window_pipeline.interrupt_for(addr)
+        except Exception:
+            pass  # parallel layer absent/uninitialized: nothing in flight
 
     def revive(self, addr: str) -> None:
         with self._lock:

@@ -313,6 +313,53 @@ class AsyncController:
         with self._lock:
             return [dict(r) for r in self._last_trajectory]
 
+    # --- checkpoint (ISSUE 17 preemption hardening) ---
+
+    def state_export(self) -> dict:
+        """Checkpointable snapshot of the learned state — EWMA inputs,
+        the (K, deadline) pair in force, last-round outcome and the
+        decision trajectory. Plain scalars/dicts only, so it rides the
+        engine checkpoint's msgpack blob; a restored controller resumes
+        tuning from the same EWMA point instead of cold."""
+        with self._lock:
+            return {
+                "ia_q": self._ia_q,
+                "tau_mean": self._tau_mean,
+                "last_reason": self._last_reason,
+                "last_arrivals": int(self._last_arrivals),
+                "last_fill_frac": self._last_fill_frac,
+                "k": self._k,
+                "deadline": self._deadline,
+                "trajectory": [dict(r) for r in self._trajectory],
+            }
+
+    def state_import(self, state: dict) -> None:
+        """Restore a :meth:`state_export` snapshot in place (the resume
+        half — the trajectory picks up where the killed run left off,
+        capped at the usual bound)."""
+        with self._lock:
+            self._ia_q = (
+                float(state["ia_q"]) if state.get("ia_q") is not None else None
+            )
+            self._tau_mean = (
+                float(state["tau_mean"])
+                if state.get("tau_mean") is not None
+                else None
+            )
+            reason = state.get("last_reason")
+            self._last_reason = str(reason) if reason is not None else None
+            self._last_arrivals = int(state.get("last_arrivals", 0))
+            fill = state.get("last_fill_frac")
+            self._last_fill_frac = float(fill) if fill is not None else None
+            self._k = int(state["k"]) if state.get("k") is not None else None
+            self._deadline = (
+                float(state["deadline"])
+                if state.get("deadline") is not None
+                else None
+            )
+            traj = [dict(r) for r in state.get("trajectory", [])]
+            self._trajectory = traj[-_TRAJECTORY_CAP:]
+
     def reset(self) -> None:
         """Drop all learned state (a controller belongs to one
         experiment; NodeState.clear calls this at teardown). The

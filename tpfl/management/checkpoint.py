@@ -2,13 +2,18 @@
 (SURVEY §5.4: ``enable_checkpointing=False``, in-memory pickle blobs
 only; "the TPU build should add orbax-style checkpointing").
 
-Two tiers:
+Three tiers:
 
 - :func:`save_node_checkpoint` / :func:`load_node_checkpoint` — one FL
   node's durable state (model params + aux + contributors/info, round
   metadata) using tpfl's own dtype-preserving msgpack wire format. A
   restarted node loads the model and rejoins the federation; the gossip
   protocol (FullModelCommand) catches it up from there.
+- :class:`EngineCheckpointer` / :func:`install_sigterm_checkpoint` —
+  the fused engine's full run state (params/variates/aux + FedBuff
+  schedule position, controller trajectory, quarantine + membership
+  state, RNG seed) as UNPADDED host numpy, restorable onto a different
+  mesh shape; the SIGTERM hook turns preemption into a resumable event.
 - :class:`SliceCheckpointer` — orbax-backed save/restore of the TPU
   execution layer's (possibly mesh-sharded) stacked pytrees
   (VmapFederation params/aux, ShardedTrainer FSDP state). Orbax handles
@@ -79,6 +84,15 @@ def save_node_checkpoint(
     with open(os.path.join(path, _META_FILE), "w") as f:
         json.dump(meta, f)
 
+    _publish(directory, sub)
+
+
+def _publish(directory: str, sub: str) -> None:
+    """Atomically point ``LATEST`` at ``sub`` and retire the rest.
+
+    The single ``os.replace`` is the publication event — everything in
+    ``sub`` must already be fully written. Shared by the node- and
+    engine-level savers so both get identical crash semantics."""
     pointer_tmp = os.path.join(directory, _LATEST + ".tmp")
     old = _read_latest(directory)
     with open(pointer_tmp, "w") as f:
@@ -154,6 +168,125 @@ def load_node_checkpoint(
     with open(os.path.join(path, _META_FILE)) as f:
         meta = json.load(f)
     return model, meta
+
+
+_ENGINE_FILE = "engine.tpfl"
+
+
+class EngineCheckpointer:
+    """Durable engine-state checkpoints (ISSUE 17 preemption hardening).
+
+    Persists the **unpadded host-side** state dict produced by
+    :meth:`~tpfl.parallel.engine.FederationEngine.export_state` —
+    params/variates/aux plus the FedBuff schedule position
+    (``rounds_done``), AsyncController trajectory, quarantine state,
+    membership slot map and the RNG seed — as one msgpack blob, using
+    the same write-subdir-then-``os.replace``-LATEST publication as
+    :func:`save_node_checkpoint` (a kill at any byte leaves the prior
+    checkpoint readable). Because the payload is host numpy with no
+    sharding baked in, :meth:`restore` hands back a dict that
+    :meth:`~tpfl.parallel.engine.FederationEngine.import_state` can
+    re-place onto ANY mesh shape — 1×1 ↔ 4×2 resumes are the point.
+    """
+
+    def __init__(self, directory: str, node: str = "engine") -> None:
+        self._dir = os.path.abspath(directory)
+        self.node = node
+        os.makedirs(self._dir, exist_ok=True)
+
+    def save(
+        self,
+        state: dict[str, Any],
+        step: int,
+        extra: Optional[dict[str, Any]] = None,
+    ) -> str:
+        """Write ``state`` as checkpoint ``step``; returns the subdir
+        name. Serialization happens on the CALLER's thread — pair with
+        the engine's async host copy so the D2H leg is already done and
+        this is pure host I/O off the dispatch critical path."""
+        from flax import serialization as flax_ser
+
+        import uuid
+
+        sub = f"ckpt_{uuid.uuid4().hex[:8]}"
+        path = os.path.join(self._dir, sub)
+        os.makedirs(path)
+        with open(os.path.join(path, _ENGINE_FILE), "wb") as f:
+            f.write(flax_ser.msgpack_serialize(state))
+        meta = {"step": int(step), "node": self.node, **(extra or {})}
+        with open(os.path.join(path, _META_FILE), "w") as f:
+            json.dump(meta, f)
+        _publish(self._dir, sub)
+        return sub
+
+    def restore(self) -> "Optional[tuple[dict[str, Any], dict[str, Any]]]":
+        """``(state, meta)`` of the published checkpoint, or None when
+        nothing was ever published (fresh start)."""
+        from flax import serialization as flax_ser
+
+        sub = _read_latest(self._dir)
+        if sub is None:
+            return None
+        path = os.path.join(self._dir, sub)
+        with open(os.path.join(path, _ENGINE_FILE), "rb") as f:
+            state = flax_ser.msgpack_restore(f.read())
+        with open(os.path.join(path, _META_FILE)) as f:
+            meta = json.load(f)
+        return state, meta
+
+    def latest_step(self) -> Optional[int]:
+        restored = None
+        sub = _read_latest(self._dir)
+        if sub is None:
+            return None
+        try:
+            with open(os.path.join(self._dir, sub, _META_FILE)) as f:
+                restored = json.load(f).get("step")
+        except (OSError, ValueError):
+            return None
+        return int(restored) if restored is not None else None
+
+
+def install_sigterm_checkpoint(
+    checkpointer: EngineCheckpointer,
+    state_fn: Any,
+    node: str = "engine",
+) -> Any:
+    """Arm preemption hardening: on SIGTERM, drain the flight recorder
+    and publish a final checkpoint from ``state_fn()`` before chaining
+    to the previously-installed handler.
+
+    ``state_fn`` must return an already-materialized host state dict
+    (e.g. the learner's latest cadence snapshot) or None — the handler
+    runs at an arbitrary interpreter point and must NOT touch in-flight
+    device buffers. Returns the previous handler so the caller can
+    restore it (``signal.signal(signal.SIGTERM, prev)``) when the fit
+    finishes. Main thread only (CPython restricts ``signal.signal``).
+    """
+    import signal
+
+    prev = signal.getsignal(signal.SIGTERM)
+
+    def _handler(signum: int, frame: Any) -> None:
+        from tpfl.management.telemetry import flight
+
+        try:
+            flight.dump(node, "sigterm")
+        except Exception:
+            pass
+        try:
+            state = state_fn()
+            if state is not None:
+                step = int(state.get("rounds_done", 0) or 0)
+                checkpointer.save(state, step, extra={"reason": "sigterm"})
+        except Exception:
+            # A failed final checkpoint must not mask the shutdown.
+            pass
+        if callable(prev):
+            prev(signum, frame)
+
+    signal.signal(signal.SIGTERM, _handler)
+    return prev
 
 
 class SliceCheckpointer:

@@ -314,6 +314,41 @@ class QuarantineEngine:
             self._actions.clear()
             self._last.clear()
 
+    # --- checkpoint (ISSUE 17 preemption hardening) ---
+
+    def state_export(self) -> dict:
+        """Checkpointable snapshot — per-peer quarantine/probation
+        records, the action log and the verdict cache, as plain
+        scalars/lists (tuples flattened) so it rides the engine
+        checkpoint's msgpack blob. A resumed node keeps its verdicts:
+        a quarantined peer stays masked across preemption instead of
+        getting a fresh probation clock."""
+        with self._lock:
+            return {
+                "state": {
+                    p: {**r, "reasons": list(r.get("reasons", []))}
+                    for p, r in self._state.items()
+                },
+                "actions": [dict(a) for a in self._actions],
+                "last": {p: [v[0], dict(v[1])] for p, v in self._last.items()},
+            }
+
+    def state_import(self, state: dict) -> None:
+        """Restore a :meth:`state_export` snapshot in place (the verdict
+        cache's ``(round, verdict)`` tuples are rebuilt from the
+        msgpack-flattened lists)."""
+        with self._lock:
+            self._state = {
+                str(p): dict(r) for p, r in state.get("state", {}).items()
+            }
+            self._actions = [dict(a) for a in state.get("actions", [])][
+                -_ACTION_LOG_CAP:
+            ]
+            self._last = {
+                str(p): (int(v[0]), dict(v[1]))
+                for p, v in state.get("last", {}).items()
+            }
+
 
 # --- deterministic verdict surface ----------------------------------------
 

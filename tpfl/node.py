@@ -190,6 +190,16 @@ class Node:
         if trainer is not None and trainer.is_alive():
             self.learner.interrupt_fit()
             trainer.join(timeout=5.0)
+        # An engine window pipeline running for this node must retire
+        # its in-flight window (donated buffers, prefetch thread)
+        # before teardown proceeds — interrupt_fit only flags the
+        # learner; this reaches the pipeline's own abort seam.
+        try:
+            from tpfl.parallel import window_pipeline
+
+            window_pipeline.interrupt_for(self.addr)
+        except Exception:
+            pass  # parallel layer absent/uninitialized: nothing in flight
         self.communication.stop()
         logger.unregister_node(self.addr)
         self._running = False

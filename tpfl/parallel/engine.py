@@ -478,6 +478,29 @@ class EngineWindow:
         self._result = result
         return result
 
+    def abandon(self) -> None:
+        """Drop an in-flight window WITHOUT its host leg (no telemetry
+        fan-out, no profiler rows): block until the device program has
+        retired — the handle holds the only reference to the donated
+        state's successor buffers, so dropping it while the program
+        still runs would free device memory out from under the
+        executing dispatch — then mark the handle finalized with no
+        result. The shutdown seam for ``Node.stop`` and the chaos
+        harness's crash paths (``window_pipeline.interrupt_for``):
+        a stopping node's open window is retired cleanly instead of
+        leaking into the runtime. Idempotent, and a no-op after
+        :meth:`finalize`."""
+        if self._finalized:
+            return
+        self._finalized = True
+        try:
+            # host-sync: shutdown boundary — deliberate drain so the
+            # donated buffers outlive the executing program.
+            jax.block_until_ready(self._outs[4])
+        except Exception:
+            pass  # a failed dispatch already dumped its flight ring
+        self._result = None
+
 
 def _sequence_parallel_module(module: Any, mesh: Mesh) -> Any:
     """Clone a transformer module onto ring attention over the 2D
@@ -620,9 +643,20 @@ class FederationEngine:
         #: the same concurrency-adaptation observations as gRPC-tier
         #: arrivals. None (default) = no feed.
         self.controller: Optional[Any] = None
+        #: Optional MembershipView (tpfl.parallel.membership) driving
+        #: the elastic weight mask; attach_membership keeps this
+        #: engine's node axis at the view's capacity tier. None
+        #: (default) = fixed membership.
+        self.membership: Optional[Any] = None
         #: [padded_nodes] 1/0 mask of real vs pad rows (the uniform
         #: fallback denominator when a round's weights are all-zero).
         self.valid = valid_node_mask(self.n_nodes, self.padded_nodes)
+        if Settings.COMPILE_CACHE_DIR:
+            # Persistent compilation cache (COMPILE_CACHE_DIR): warm
+            # processes reload lowered executables instead of
+            # recompiling; the observatory's
+            # tpfl_compile_cache_warm_total counts the reloads.
+            profiling.ensure_compile_cache(str(Settings.COMPILE_CACHE_DIR))
 
     # --- state / data placement ---
 
@@ -750,6 +784,149 @@ class FederationEngine:
             self._shard(self.pad_stacked(jnp.asarray(xs))),
             self._shard(self.pad_stacked(jnp.asarray(ys))),
         )
+
+    # --- elastic membership ----------------------------------------------
+
+    def resize_nodes(self, n_nodes: int) -> None:
+        """Move this engine to a new capacity tier: re-derive the
+        padded node axis and validity mask. Cached programs are KEPT —
+        the capacity is a program-cache key axis, so each tier's
+        programs live in their own slots and returning to a
+        previously-compiled tier is a cache hit (zero recompiles);
+        only a never-seen tier lowers fresh."""
+        self.n_nodes = int(n_nodes)
+        self.padded_nodes = padded_node_count(self.n_nodes, self.mesh)
+        self.valid = valid_node_mask(self.n_nodes, self.padded_nodes)
+
+    def attach_membership(self, view: Any) -> None:
+        """Drive this engine's node axis from a
+        :class:`~tpfl.parallel.membership.MembershipView`: the engine
+        follows the view's capacity tier (resizing now and on
+        :meth:`sync_membership`), and callers take each window's fold
+        weights from ``view.weights()`` — joins, leaves, crashes and
+        quarantine verdicts become pure mask edits."""
+        self.membership = view
+        if int(view.capacity) != self.n_nodes:
+            self.resize_nodes(int(view.capacity))
+
+    def sync_membership(self) -> bool:
+        """Re-align the node axis with the attached view's tier (after
+        its ``join``-driven promotions or ``maybe_resize`` demotions,
+        the latter consulted against ``self.controller``). Returns
+        whether the tier moved — i.e. whether the next window compiles
+        a new-tier program instead of mask-editing the current one."""
+        view = self.membership
+        if view is None:
+            return False
+        view.maybe_resize(self.controller)
+        if int(view.capacity) == self.n_nodes:
+            return False
+        self.resize_nodes(int(view.capacity))
+        return True
+
+    # --- checkpoint state -------------------------------------------------
+
+    def export_state(
+        self,
+        params: Any,
+        aux: Optional[Any] = None,
+        scaffold_state: Optional[tuple[Any, Any]] = None,
+        quarantine: Optional[Any] = None,
+    ) -> dict:
+        """One checkpointable snapshot of the engine-side federation
+        state: UNPADDED host-numpy logical rows (mesh-agnostic — a
+        checkpoint written on a 1×1 mesh restores onto 4×2 and back,
+        placement happens at :meth:`import_state`), plus the schedule
+        position (``rounds_done`` — a resumed :class:`FedBuffSchedule`
+        and the learner's seeded per-window data stream both index off
+        it), the window ordinal, the seed the per-window RNG streams
+        derive from, and the attached controller / membership (and an
+        optional quarantine engine's) exported state.
+
+        host-sync by design: checkpointing is a consumption boundary —
+        callers snapshot OFF the critical path (the window pipeline
+        rides the ``copy_to_host_async`` host leg)."""
+
+        def host(tree: Any) -> Any:
+            return jax.tree_util.tree_map(
+                # host-sync: checkpoint consumption boundary (see
+                # above). np.array, not np.asarray: on the CPU backend
+                # asarray is a ZERO-COPY view of the device buffer, and
+                # a later donating round may overwrite that buffer in
+                # place (deserialized persistent-cache executables do)
+                # — the checkpoint must own its bytes.
+                lambda x: np.array(x), self.unpad(tree)
+            )
+
+        state: dict = {
+            "params": host(params),
+            "n_nodes": int(self.n_nodes),
+            "rounds_done": int(self._rounds_done),
+            "windows": int(self._windows),
+            "seed": int(self.seed),
+        }
+        if aux is not None:
+            state["aux"] = host(aux)
+        if scaffold_state is not None:
+            c_locals, c_global = scaffold_state
+            state["c_locals"] = host(c_locals)
+            state["c_global"] = jax.tree_util.tree_map(
+                # host-sync: checkpoint consumption boundary (owning
+                # copy — see host()).
+                lambda x: np.array(x), c_global
+            )
+        if self.controller is not None:
+            state["controller"] = self.controller.state_export()
+        if self.membership is not None:
+            state["membership"] = self.membership.state_export()
+        if quarantine is not None:
+            state["quarantine"] = quarantine.state_export()
+        return state
+
+    def import_state(self, state: dict, quarantine: Optional[Any] = None) -> dict:
+        """Restore an :meth:`export_state` snapshot onto THIS engine's
+        mesh — the elastic half of kill-and-resume: the node axis
+        resizes to the checkpoint's logical count, the host trees are
+        re-padded and re-placed for this mesh's shape/layout
+        (``_shard_state``), and the schedule position, controller,
+        membership and (optionally) quarantine state come back live.
+        Returns ``{"params", "aux", "scaffold_state"}`` ready for the
+        next :meth:`dispatch_window` (absent pieces are None)."""
+        n = int(state["n_nodes"])
+        if n != self.n_nodes:
+            self.resize_nodes(n)
+        self._rounds_done = int(state.get("rounds_done", 0))
+        self._windows = int(state.get("windows", 0))
+
+        def place(tree: Any) -> Any:
+            return self._shard_state(self.pad_stacked(tree))
+
+        out: dict = {
+            "params": place(state["params"]),
+            "aux": None,
+            "scaffold_state": None,
+        }
+        if "aux" in state:
+            out["aux"] = place(state["aux"])
+        if "c_locals" in state:
+            out["scaffold_state"] = (
+                place(state["c_locals"]),
+                self._shard_global(state["c_global"]),
+            )
+        if self.controller is not None and state.get("controller"):
+            self.controller.state_import(state["controller"])
+        if state.get("membership"):
+            if self.membership is None:
+                from tpfl.parallel.membership import MembershipView
+
+                self.membership = MembershipView.from_state(
+                    state["membership"]
+                )
+            else:
+                self.membership.state_import(state["membership"])
+        if quarantine is not None and state.get("quarantine"):
+            quarantine.state_import(state["quarantine"])
+        return out
 
     # --- program construction -------------------------------------------
 
@@ -1403,7 +1580,12 @@ class FederationEngine:
         codec: int = 0, topk_frac: float = 0.05,
         model_axes: int = 1, layout: str = "replicated",
         fedbuff: bool = False, stale_exp: float = 0.0,
+        capacity: int = 0, mesh_nodes: int = 1,
     ) -> Callable:
+        # capacity / mesh_nodes are pure cache-key axes: the padded
+        # tier and mesh shape already determine the abstract shapes
+        # and the shard_map lowering this build closes over.
+        del capacity, mesh_nodes
         multi = self._build_multi(
             kind, epochs, n_rounds, w_ndim, telemetry, a_ndim, codec,
             topk_frac, fedbuff, stale_exp,
@@ -1464,6 +1646,7 @@ class FederationEngine:
         codec: int = 0, topk_frac: float = 0.05,
         model_axes: int = 1, layout: str = "replicated",
         fedbuff: bool = False, stale_exp: float = 0.0,
+        capacity: int = 0, mesh_nodes: int = 1,
     ) -> Callable:
         """Cached compiled program for ``(kind, epochs, n_rounds,
         w_ndim)`` — the raw jitted callable (bench drives these from
@@ -1487,11 +1670,18 @@ class FederationEngine:
         ``ASYNC_STALENESS_EXP``) are key axes too: the staleness
         exponent is a trace-time constant of the fold weighting, so
         flipping the knob between windows must select a different
-        compiled program."""
+        compiled program. ``capacity``/``mesh_nodes`` (the ISSUE-17
+        elastic axes: the padded capacity tier the program is shaped
+        for, and the mesh's node-axis size the shard_map lowering
+        closed over) make the elastic/resume contract explicit in the
+        key: a tier promotion or a restore onto a different mesh shape
+        selects its own slot — and DEMOTING back to a seen tier is a
+        cache hit, so tier oscillation compiles each tier once."""
         key = (
             kind, int(epochs), int(n_rounds), int(w_ndim), bool(donate),
             bool(telemetry), int(a_ndim), int(codec), float(topk_frac),
             int(model_axes), str(layout), bool(fedbuff), float(stale_exp),
+            int(capacity), int(mesh_nodes),
         )
         fn = self._programs.get(key)
         profiling.observatory.cache_event("engine_programs", hit=fn is not None)
@@ -1505,17 +1695,19 @@ class FederationEngine:
         codec: int = 0, topk_frac: float = 0.05,
         model_axes: int = 1, layout: str = "replicated",
         fedbuff: bool = False, stale_exp: float = 0.0,
+        capacity: int = 0, mesh_nodes: int = 1,
     ) -> Callable:
         """The same program behind the compile observatory's recompile
         detection (keyed per (engine program, abstract shapes) like
         every other jit seam). Variant programs get their own names —
-        the telemetry/attack/codec/2D-mesh/fedbuff signatures differ
-        by construction and must not read as recompile storms of the
-        base program."""
+        the telemetry/attack/codec/2D-mesh/fedbuff (and capacity-tier)
+        signatures differ by construction and must not read as
+        recompile storms of the base program."""
         key = (
             kind, int(epochs), int(n_rounds), int(w_ndim), bool(donate),
             bool(telemetry), int(a_ndim), int(codec), float(topk_frac),
             int(model_axes), str(layout), bool(fedbuff), float(stale_exp),
+            int(capacity), int(mesh_nodes),
         )
         fn = self._wrapped.get(key)
         if fn is None:
@@ -1525,6 +1717,7 @@ class FederationEngine:
                 + (f":{compression.codec_name(codec)}" if codec else "")
                 + (f":m{int(model_axes)}" if int(model_axes) > 1 else "")
                 + (":fb" if fedbuff else "")
+                + (f":c{int(capacity)}" if capacity else "")
             )
             wrapped = profiling.observatory.wrap(
                 self.program(*key),
@@ -1719,6 +1912,8 @@ class FederationEngine:
             kind, epochs, n_rounds, w.ndim, donate=True,
             telemetry=tele_on, codec=codec, topk_frac=frac,
             model_axes=self.model_axes, layout=self.layout.name,
+            capacity=int(self.padded_nodes),
+            mesh_nodes=mesh_axis_size(self.mesh),
         )
         return donation_analysis(fn, tuple(args))
 
@@ -1845,9 +2040,17 @@ class FederationEngine:
             float(Settings.ASYNC_STALENESS_EXP) if fedbuff else 0.0
         )
         model_axes, mesh_layout = self.model_axes, self.layout.name
+        # The elastic key axes, resolved at dispatch like the knobs:
+        # the padded capacity tier this window is shaped for, and the
+        # mesh's node-axis size the lowering closed over — a tier
+        # promotion or a restore onto another mesh shape must select
+        # its own cache slot, never mutate a compiled program.
+        capacity = int(self.padded_nodes)
+        mesh_nodes = mesh_axis_size(self.mesh)
         fn = self._wrapped_program(
             kind, epochs, n_rounds, w.ndim, donate, tele_on, a_ndim,
             codec, frac, model_axes, mesh_layout, fedbuff, stale_exp,
+            capacity, mesh_nodes,
         )
         if Settings.TRACE_CONTRACTS:
             # Dispatch-time contract: the fetched program's build-time

@@ -70,6 +70,14 @@ class FederationLearner(Learner):
         self.partition_strategy = partition_strategy
         self.seed = int(seed)
         self._interrupt = threading.Event()
+        # Elastic membership over the local node rows (ISSUE 17):
+        # attached to the engine at fit(); churn between windows lands
+        # as weight-mask edits via _window_weights. None = all rows
+        # live (legacy behavior, no mask built).
+        self.membership: Optional[Any] = None
+        # Latest cadence checkpoint state (host numpy) — what the
+        # SIGTERM handler publishes; never touches in-flight buffers.
+        self._last_snapshot: "Optional[dict]" = None
         self._fed: Optional[VmapFederation] = None
         self._train_xs: Optional[Any] = None
         self._train_ys: Optional[Any] = None
@@ -85,6 +93,24 @@ class FederationLearner(Learner):
         super().set_data(data)
         self._train_xs = self._eval_xs = None
         self._host_train = None
+
+    def set_membership(self, view: Any) -> None:
+        """Attach a :class:`~tpfl.parallel.membership.MembershipView`
+        over the local node rows. While attached, every window's fold
+        weights come from the view (:meth:`_window_weights`) — joins,
+        leaves, crashes and quarantine verdicts between windows are
+        mask edits with zero recompiles; only a capacity-tier change
+        restacks (handled at the next :meth:`fit`)."""
+        self.membership = view
+
+    def _window_weights(self, widx: int) -> "Optional[np.ndarray]":
+        """Window ``widx``'s fold-weight vector from the attached
+        membership view (None = unmasked legacy weighting). Called
+        between windows by both drivers — the elastic re-mask seam."""
+        del widx  # churn is wall-clock, not window-indexed
+        if self.membership is None:
+            return None
+        return self.membership.weights()
 
     def _ensure_fed(self) -> VmapFederation:
         if self._fed is None:
@@ -179,7 +205,20 @@ class FederationLearner(Learner):
     def fit(self) -> TpflModel:
         self._interrupt.clear()
         model = self.get_model()
+        if self.membership is not None:
+            cap = int(self.membership.capacity)
+            if cap != self.n_local_nodes:
+                # Capacity-tier boundary: restack the local federation
+                # at the new tier — the ONE churn event that
+                # re-partitions and re-lowers. Within a tier, fit()
+                # re-masks only (zero recompiles).
+                self.n_local_nodes = cap
+                self._fed = None
+                self._train_xs = self._eval_xs = None
+                self._host_train = None
         fed = self._ensure_fed()
+        if self.membership is not None:
+            fed.engine.attach_membership(self.membership)
         xs, ys = self._train_data()
 
         params = self._stack(model.get_parameters())
@@ -193,45 +232,115 @@ class FederationLearner(Learner):
         # of (seed, window index) on both drivers below, so
         # ENGINE_PREFETCH never changes bytes.
         window = max(1, int(Settings.SHARD_ROUNDS_PER_DISPATCH))
-        if Settings.ENGINE_PREFETCH:
-            # Free-running (Sebulba split): window N+1 is dispatched
-            # before window N's host leg runs, and the next window's
-            # batches are staged on the named prefetch thread — see
-            # tpfl.parallel.window_pipeline.
-            from tpfl.parallel.window_pipeline import WindowPipeline
+        # Preemption hardening (ISSUE 17): cadence snapshots every
+        # CHECKPOINT_EVERY_WINDOWS windows into CHECKPOINT_DIR, and —
+        # under CHECKPOINT_ON_SIGTERM, main thread only — a SIGTERM
+        # handler that publishes the latest snapshot on the way out.
+        ckpt = None
+        snap_every = 0
+        snapshot_to = None
+        if Settings.CHECKPOINT_DIR and int(Settings.CHECKPOINT_EVERY_WINDOWS) > 0:
+            from tpfl.management.checkpoint import EngineCheckpointer
 
-            result, rounds_run = WindowPipeline(fed.engine).run(
-                params, xs, ys, epochs=self.epochs,
-                n_rounds=self.local_rounds, window=window, aux=aux,
-                data_for=self._window_data,
-                should_stop=self._interrupt.is_set,
+            ckpt = EngineCheckpointer(Settings.CHECKPOINT_DIR, node=self._addr)
+            snap_every = int(Settings.CHECKPOINT_EVERY_WINDOWS)
+
+            def snapshot_to(rounds_at: int, state: dict) -> None:
+                self._last_snapshot = state
+                ckpt.save(state, step=int(rounds_at))
+
+        sigterm_armed = False
+        prev_sigterm: Any = None
+        if (
+            Settings.CHECKPOINT_ON_SIGTERM
+            and Settings.CHECKPOINT_DIR
+            and threading.current_thread() is threading.main_thread()
+        ):
+            from tpfl.management.checkpoint import (
+                EngineCheckpointer,
+                install_sigterm_checkpoint,
             )
-            if rounds_run:
-                if aux is not None:
-                    params, aux, _losses = result
-                else:
-                    params, _losses = result
-        else:
-            rounds_run = 0
-            widx = 0
-            while rounds_run < self.local_rounds:
-                if self._interrupt.is_set():
-                    break
-                k = min(window, self.local_rounds - rounds_run)
-                staged = self._window_data(widx, rounds_run, k)
-                if staged is not None:
-                    xs, ys = staged
-                if aux is not None:
-                    params, aux, _losses = fed.run_rounds(
-                        params, xs, ys, epochs=self.epochs, aux=aux,
-                        n_rounds=k
-                    )
-                else:
-                    params, _losses = fed.run_rounds(
-                        params, xs, ys, epochs=self.epochs, n_rounds=k
-                    )
-                rounds_run += k
-                widx += 1
+
+            if ckpt is None:
+                ckpt = EngineCheckpointer(
+                    Settings.CHECKPOINT_DIR, node=self._addr
+                )
+            prev_sigterm = install_sigterm_checkpoint(
+                ckpt, lambda: self._last_snapshot, node=self._addr
+            )
+            sigterm_armed = True
+        try:
+            if Settings.ENGINE_PREFETCH:
+                # Free-running (Sebulba split): window N+1 is dispatched
+                # before window N's host leg runs, and the next window's
+                # batches are staged on the named prefetch thread — see
+                # tpfl.parallel.window_pipeline.
+                from tpfl.parallel.window_pipeline import WindowPipeline
+
+                result, rounds_run = WindowPipeline(fed.engine).run(
+                    params, xs, ys, epochs=self.epochs,
+                    n_rounds=self.local_rounds, window=window, aux=aux,
+                    data_for=self._window_data,
+                    should_stop=self._interrupt.is_set,
+                    weights_for=(
+                        self._window_weights
+                        if self.membership is not None
+                        else None
+                    ),
+                    snapshot_every=snap_every,
+                    snapshot_to=snapshot_to,
+                    owner=self._addr,
+                )
+                if rounds_run and result is None:
+                    # Interrupted shutdown (window_pipeline
+                    # .interrupt_for): the in-flight window was
+                    # abandoned, its donated buffers retired — no
+                    # usable output, keep the pre-fit model.
+                    return self.skip_fit(model)
+                if rounds_run:
+                    if aux is not None:
+                        params, aux, _losses = result
+                    else:
+                        params, _losses = result
+            else:
+                rounds_run = 0
+                widx = 0
+                while rounds_run < self.local_rounds:
+                    if self._interrupt.is_set():
+                        break
+                    k = min(window, self.local_rounds - rounds_run)
+                    staged = self._window_data(widx, rounds_run, k)
+                    if staged is not None:
+                        xs, ys = staged
+                    # The elastic re-mask seam (same as the pipeline's
+                    # weights_for): churn since the last window lands
+                    # as a weight edit, never a recompile.
+                    w = self._window_weights(widx)
+                    if aux is not None:
+                        params, aux, _losses = fed.run_rounds(
+                            params, xs, ys, weights=w, epochs=self.epochs,
+                            aux=aux, n_rounds=k
+                        )
+                    else:
+                        params, _losses = fed.run_rounds(
+                            params, xs, ys, weights=w, epochs=self.epochs,
+                            n_rounds=k
+                        )
+                    rounds_run += k
+                    widx += 1
+                    if snap_every and widx % snap_every == 0:
+                        # Sequential driver: outputs are already
+                        # materialized host-chainable arrays; snapshot
+                        # inline at the cadence.
+                        snapshot_to(
+                            rounds_run,
+                            fed.engine.export_state(params, aux=aux),
+                        )
+        finally:
+            if sigterm_armed and prev_sigterm is not None:
+                import signal
+
+                signal.signal(signal.SIGTERM, prev_sigterm)
         if rounds_run == 0:
             return self.skip_fit(model)
 

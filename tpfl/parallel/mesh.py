@@ -106,6 +106,20 @@ def padded_node_count(
     return ((int(n_nodes) + d - 1) // d) * d
 
 
+def capacity_tier(n_live: int, floor: int = 1) -> int:
+    """Smallest power-of-two ≥ ``max(n_live, floor)`` — the elastic
+    engine's capacity buckets. Programs compile at the TIER, not the
+    live count, so membership churn inside a tier is a pure weight-mask
+    edit (zero recompiles); only crossing a tier boundary re-lowers.
+    Composes with :func:`padded_node_count`: the engine pads the tier
+    up to a device multiple like any other node count."""
+    n = max(int(n_live), int(floor), 1)
+    tier = 1
+    while tier < n:
+        tier *= 2
+    return tier
+
+
 def pad_node_axis(tree: Any, n_padded: int) -> Any:
     """Pad every leaf's leading (node) axis to ``n_padded`` by cloning
     row 0 — pad rows must be VALID model/data rows (training them is

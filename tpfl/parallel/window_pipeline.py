@@ -53,12 +53,39 @@ from typing import Any, Callable, Optional
 
 from tpfl import concurrency
 from tpfl.management.telemetry import metrics
-from tpfl.parallel.engine import EngineWindow, FederationEngine, FedBuffSchedule
+from tpfl.parallel.engine import (
+    EngineWindow,
+    FederationEngine,
+    FedBuffSchedule,
+    start_host_copy,
+)
 from tpfl.settings import Settings
 
 # data_for(window_index, start_round, n_rounds) -> (xs, ys) or None
 # (None = reuse the current window's arrays).
 DataSupplier = Callable[[int, int, int], "Optional[tuple[Any, Any]]"]
+
+# Live pipelines by owner addr — the shutdown seam: Node.stop and
+# FaultInjector.crash interrupt a node's in-flight run via
+# :func:`interrupt_for` so donated buffers retire cleanly instead of
+# racing the teardown.
+# guarded-by: _ACTIVE_LOCK
+_ACTIVE: "dict[str, WindowPipeline]" = {}
+_ACTIVE_LOCK = concurrency.make_lock("window_pipeline._ACTIVE_LOCK")
+
+
+def interrupt_for(addr: str) -> bool:
+    """Interrupt the pipeline currently running for ``addr`` (no-op
+    False when none is registered). The run finishes its in-flight
+    window, finalizes or abandons the handle, and returns — callers
+    (Node.stop, FaultInjector.crash) get a clean join point instead of
+    leaked prefetch threads and unreferenced donated buffers."""
+    with _ACTIVE_LOCK:
+        pipe = _ACTIVE.get(addr)
+    if pipe is None:
+        return False
+    pipe.interrupt()
+    return True
 
 
 class WindowPrefetcher:
@@ -158,8 +185,32 @@ class WindowPipeline:
 
     def __init__(self, engine: FederationEngine) -> None:
         self.engine = engine
+        # unguarded: written only by the run() thread; cross-thread
+        # readers (bench/tests) read after run() returns.
         self.idle_gaps: list[float] = []
         self.windows_run = 0
+        # Cross-thread stop flag (interrupt_for / Node.stop) — honored
+        # at exactly the between-dispatch granularity should_stop is.
+        self._abort = threading.Event()
+
+    def interrupt(self) -> None:
+        """Request the current :meth:`run` stop at the next window
+        boundary (thread-safe; sticky until the next run starts)."""
+        self._abort.set()
+
+    def _materialize_snapshot(
+        self, snap: tuple, snapshot_to: Callable[[int, dict], None]
+    ) -> None:
+        """Consume a pending cadence snapshot: the D2H copies started
+        at dispatch have had a full device window to land, so the
+        ``np.asarray`` inside ``export_state`` reads host memory. The
+        engine's ``_rounds_done`` already equals the snapshotted
+        window's position here (it advances at dispatch, and the next
+        dispatch hasn't happened yet) — ``rounds_at`` pins it anyway."""
+        rounds_at, p, a, ss = snap
+        state = self.engine.export_state(p, aux=a, scaffold_state=ss)
+        state["rounds_done"] = int(rounds_at)
+        snapshot_to(int(rounds_at), state)
 
     def run(
         self,
@@ -177,6 +228,10 @@ class WindowPipeline:
         data_for: Optional[DataSupplier] = None,
         prefetch: Optional[bool] = None,
         should_stop: Optional[Callable[[], bool]] = None,
+        weights_for: Optional[Callable[[int], Any]] = None,
+        snapshot_every: int = 0,
+        snapshot_to: Optional[Callable[[int, dict], None]] = None,
+        owner: Optional[str] = None,
     ) -> tuple[Optional[tuple], int]:
         """Run ``n_rounds`` rounds free-running; returns
         ``(result, rounds_done)`` where ``result`` follows
@@ -194,7 +249,21 @@ class WindowPipeline:
         inline otherwise; both stagings are the same pure function of
         the window index, so the knob never changes bytes.
         ``should_stop`` is polled between dispatches (interrupt
-        honoring at exactly the sequential driver's granularity)."""
+        honoring at exactly the sequential driver's granularity).
+
+        ISSUE-17 elastic hooks: ``weights_for(widx)`` supplies each
+        window's fold-weight vector — the membership re-mask seam
+        (churn between windows edits weights only; the compiled
+        program and its shapes never move), overriding ``weights``
+        when given. ``snapshot_every``/``snapshot_to`` arm cadence
+        checkpointing: every K-th window's output state is snapshotted
+        OFF the critical path — the D2H copy starts non-blocking at
+        dispatch (:func:`~tpfl.parallel.engine.start_host_copy`) and
+        materializes at the NEXT loop top, before the dispatch that
+        would donate those buffers away, so the device pipeline never
+        stalls on checkpoint I/O. ``snapshot_to(rounds_done, state)``
+        receives :meth:`FederationEngine.export_state` output.
+        ``owner`` registers this run for :func:`interrupt_for`."""
         eng = self.engine
         window = max(
             1,
@@ -223,6 +292,15 @@ class WindowPipeline:
         )
         self.idle_gaps = []
         self.windows_run = 0
+        self._abort.clear()
+        if owner is not None:
+            with _ACTIVE_LOCK:
+                _ACTIVE[owner] = self
+        snap_every = max(0, int(snapshot_every)) if snapshot_to else 0
+        # (rounds_done_after_window, params, aux, scaffold_state) of a
+        # window whose host copy is in flight; materialized at the next
+        # loop top, BEFORE the dispatch that donates those buffers.
+        snap_pending: Optional[tuple] = None
         pending: Optional[EngineWindow] = None
         result: Optional[tuple] = None
         done = 0
@@ -230,9 +308,20 @@ class WindowPipeline:
         cur_xs, cur_ys = xs, ys
         try:
             while done < int(n_rounds):
-                if should_stop is not None and should_stop():
+                if snap_pending is not None:
+                    self._materialize_snapshot(snap_pending, snapshot_to)
+                    snap_pending = None
+                if self._abort.is_set() or (
+                    should_stop is not None and should_stop()
+                ):
                     break
                 k = min(window, int(n_rounds) - done)
+                if weights_for is not None:
+                    # The elastic re-mask seam: membership churn since
+                    # the last window lands here as a weight-vector
+                    # edit — same program, same shapes, zero recompile.
+                    w = weights_for(widx)
+                    per_round_w = getattr(w, "ndim", 1) == 2
                 # This window's data: taken from the prefetch thread
                 # (staged while the previous window ran) or computed
                 # inline — same supplier, same bytes.
@@ -294,11 +383,43 @@ class WindowPipeline:
                 done += k
                 widx += 1
                 self.windows_run += 1
+                if snap_every and widx % snap_every == 0:
+                    # Cadence checkpoint: start the non-blocking D2H
+                    # copy NOW (it completes while the device runs this
+                    # window); np.asarray at the next loop top reads
+                    # host memory — the copy_to_host_async host leg.
+                    start_host_copy(params)
+                    if aux is not None:
+                        start_host_copy(aux)
+                    if scaffold:
+                        start_host_copy(scaffold_state)
+                    snap_pending = (
+                        done,
+                        params,
+                        aux,
+                        scaffold_state if scaffold else None,
+                    )
         finally:
+            if owner is not None:
+                with _ACTIVE_LOCK:
+                    if _ACTIVE.get(owner) is self:
+                        del _ACTIVE[owner]
             if prefetcher is not None:
                 prefetcher.close()
             if pending is not None:
-                result = pending.finalize()
+                if self._abort.is_set():
+                    # Interrupted shutdown (Node.stop / fault injector):
+                    # retire the donated buffers without the telemetry
+                    # fan-out — the handle must not outlive the run.
+                    pending.abandon()
+                    result = None
+                else:
+                    result = pending.finalize()
+        if snap_pending is not None and not self._abort.is_set():
+            # The run ended with a copy still in flight (final window
+            # hit the cadence): no further dispatch will donate these
+            # buffers, so materializing here is safe and loses nothing.
+            self._materialize_snapshot(snap_pending, snapshot_to)
         if self.idle_gaps:
             metrics.gauge(
                 "tpfl_engine_idle_gap_seconds",

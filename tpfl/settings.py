@@ -732,6 +732,66 @@ class Settings:
     rebind from the outputs (``profiling.best_of_wall_donated``).
     False: every dispatch allocates fresh outputs (debugging aid)."""
 
+    ELASTIC_CAPACITY_MIN: int = 2
+    """Floor of the elastic engine's pow-2 capacity tiers
+    (tpfl.parallel.membership.MembershipView /
+    tpfl.parallel.mesh.capacity_tier): the engine compiles its round
+    programs at the smallest power-of-two ≥ max(live members, this
+    floor), so joins/leaves/crashes/quarantine evictions inside a tier
+    are pure weight-mask edits with ZERO recompiles — only crossing a
+    tier boundary lowers a new program (and returning to a seen tier
+    is a cache hit; the capacity is a program-cache key axis). A
+    higher floor trades padded rows (wasted device work) for headroom
+    before the first promotion. Read when a MembershipView is built.
+    See docs/deployment.md "Elastic membership & preemption"."""
+
+    COMPILE_CACHE_DIR: str = ""
+    """Directory for JAX's persistent compilation cache, wired into
+    the engine's program cache (tpfl.management.profiling
+    .ensure_compile_cache, called at FederationEngine construction):
+    when set, every XLA executable the engine lowers is written to
+    disk, and a restarted/preempted process RELOADS it instead of
+    recompiling — cold-start cost after kill-and-resume drops to cache
+    I/O. The observatory counts the reloads in the always-on
+    ``tpfl_compile_cache_warm_total`` counter so cold-start cost is
+    measurable in production. "" (default) leaves JAX's cache
+    configuration untouched. Read at engine construction."""
+
+    CHECKPOINT_DIR: str = ""
+    """Directory for engine-state checkpoints
+    (tpfl.management.checkpoint.EngineCheckpointer): when set,
+    ``FederationLearner.fit`` snapshots the engine federation state —
+    params/variates/aux as UNPADDED host rows (mesh-agnostic: a
+    checkpoint written on a 1×1 mesh restores onto 4×2 and back),
+    plus the FedBuff schedule position, AsyncController trajectory,
+    quarantine/probation state, membership slots and RNG seed — every
+    ``CHECKPOINT_EVERY_WINDOWS`` windows, atomically
+    pointer-published (the same LATEST discipline as node
+    checkpoints). "" (default): no engine checkpointing. Read per
+    fit() call."""
+
+    CHECKPOINT_EVERY_WINDOWS: int = 0
+    """Snapshot cadence for CHECKPOINT_DIR, in engine windows: every
+    K-th window's output state is copied device→host OFF the critical
+    path (the snapshot rides the window pipeline's
+    ``copy_to_host_async`` host leg, landing while the next window's
+    device work runs) and written as a checkpoint. 0 (default)
+    disables cadence snapshots even when CHECKPOINT_DIR is set (the
+    SIGTERM path below can still emit a final checkpoint). The bench
+    ``elastic`` tier gates the cadence overhead inside a 5% budget.
+    Read per fit() call."""
+
+    CHECKPOINT_ON_SIGTERM: bool = False
+    """Preemption hardening: when on (and CHECKPOINT_DIR is set),
+    ``FederationLearner.fit`` installs a SIGTERM handler
+    (tpfl.management.checkpoint.install_sigterm_checkpoint) that
+    drains the flight recorder and emits a final checkpoint of the
+    last completed snapshot before chaining the previous handler — a
+    preempted host resumes mid-experiment instead of losing the run.
+    Main-thread only (the signal module's rule); the handler is
+    removed when fit returns. Off by default: shutdown paths stay
+    exactly the PR-16 behavior. Read per fit() call."""
+
     # --- concurrency diagnostics ---
     TRACE_CONTRACTS: bool = False
     """Opt-in runtime trace-contract checking (tpfl.concurrency): every
@@ -908,6 +968,14 @@ class Settings:
         # is byte-identical (test_engine_async pins it) but interleaves
         # host work, which single-stepping tests don't want.
         cls.ENGINE_PREFETCH = False
+        # Elastic/preemption machinery off by default in tests: fixed
+        # membership and no disk traffic keep seeded runs hermetic;
+        # the elastic tests opt in per-case with explicit views/dirs.
+        cls.ELASTIC_CAPACITY_MIN = 2
+        cls.COMPILE_CACHE_DIR = ""
+        cls.CHECKPOINT_DIR = ""
+        cls.CHECKPOINT_EVERY_WINDOWS = 0
+        cls.CHECKPOINT_ON_SIGTERM = False
 
     @classmethod
     def set_standalone_settings(cls) -> None:
@@ -1025,6 +1093,14 @@ class Settings:
         # Interactive single-host runs: the free-running driver only
         # helps once windows carry real work; opt in per-experiment.
         cls.ENGINE_PREFETCH = False
+        # Elastic/preemption machinery opt-in here like the other ops
+        # knobs: point CHECKPOINT_DIR/COMPILE_CACHE_DIR at durable
+        # paths for runs you intend to preempt and resume.
+        cls.ELASTIC_CAPACITY_MIN = 2
+        cls.COMPILE_CACHE_DIR = ""
+        cls.CHECKPOINT_DIR = ""
+        cls.CHECKPOINT_EVERY_WINDOWS = 0
+        cls.CHECKPOINT_ON_SIGTERM = False
 
     @classmethod
     def set_scale_settings(cls) -> None:
@@ -1209,6 +1285,16 @@ class Settings:
         # dispatch RTT, telemetry fan-out and batch staging all
         # overlap device compute (byte-identical either way).
         cls.ENGINE_PREFETCH = True
+        # Long-running fleets resize and get preempted — the scale
+        # profile keeps the elastic floor at 2 (first promotion cheap)
+        # and SIGTERM hardening ON so a preempted host leaves a final
+        # checkpoint; the dirs stay empty (operator-provided paths —
+        # durable storage is a deployment decision, not a profile's).
+        cls.ELASTIC_CAPACITY_MIN = 2
+        cls.COMPILE_CACHE_DIR = ""
+        cls.CHECKPOINT_DIR = ""
+        cls.CHECKPOINT_EVERY_WINDOWS = 0
+        cls.CHECKPOINT_ON_SIGTERM = True
 
     @classmethod
     def snapshot(cls) -> dict[str, Any]:
