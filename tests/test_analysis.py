@@ -631,6 +631,11 @@ ENGINE_KEY_AXES = (
     # own cache slot, never replay a stale-shaped program
     ("int(capacity), ", "capacity"),
     ("int(mesh_nodes),", "mesh_nodes"),
+    # the ISSUE-18 cross-host / cross-device axes: the hosts-axis size
+    # the two-leg psum closed over, and the registered census the
+    # window's cohort was sampled from
+    ("int(mesh_hosts), ", "mesh_hosts"),
+    ("int(pop_size),", "pop_size"),
 )
 
 
@@ -776,6 +781,58 @@ def test_spmd_fixture_model_axis_names(tmp_path):
     assert found and "no enclosing shard_map" in found[0].message, [
         v.render() for v in found
     ]
+
+
+def test_spmd_fixture_hosts_axis_names(tmp_path):
+    """ISSUE-18 satellite: the cross-host ``hosts`` axis rides the
+    same one-hop import rule — a two-leg fold (psum over NODE_AXIS
+    then HOST_AXIS) passes when the PartitionSpec binds both, and an
+    UNBOUND hosts-axis psum fails the pass."""
+    good = """\
+        import jax
+        from jax import lax
+        from jax.sharding import PartitionSpec
+        from tpfl.parallel.compat import shard_map
+        from tpfl.parallel.mesh import HOST_AXIS, NODE_AXIS
+
+
+        def fold(x):
+            partial = lax.psum(x, NODE_AXIS)
+            return lax.psum(partial, HOST_AXIS)
+
+
+        def outer(mesh, x):
+            spec = PartitionSpec((HOST_AXIS, NODE_AXIS))
+            fn = shard_map(fold, mesh=mesh, in_specs=(spec,),
+                           out_specs=spec)
+            return fn(x)
+    """
+    mesh_src = (
+        'NODE_AXIS = "nodes"\nMODEL_AXIS = "model"\n'
+        'HOST_AXIS = "hosts"\n'
+    )
+    root = _mini_repo(
+        tmp_path,
+        {"tpfl/dcn.py": good, "tpfl/parallel/mesh.py": mesh_src},
+    )
+    assert check_spmd(root) == [], [v.render() for v in check_spmd(root)]
+    # Unbound: the enclosing shard_map binds only the node axis, so
+    # the hosts-leg psum has no binding anywhere in scope.
+    bad = good.replace(
+        "spec = PartitionSpec((HOST_AXIS, NODE_AXIS))",
+        "spec = PartitionSpec(NODE_AXIS)",
+    )
+    root2 = _mini_repo(
+        tmp_path / "bad",
+        {"tpfl/dcn.py": bad, "tpfl/parallel/mesh.py": mesh_src},
+    )
+    found = check_spmd(root2)
+    assert found and "no enclosing shard_map" in found[0].message, [
+        v.render() for v in found
+    ]
+    # The violation anchors on the hosts-leg psum (fixture line 10),
+    # not the node-leg one the spec still binds.
+    assert "tpfl/dcn.py:10" in found[0].key, found[0].key
 
 
 def test_spmd_fixture_axis_generic_helper(tmp_path):
@@ -937,17 +994,19 @@ def test_trace_contracts_engine_dispatch_witness(_trace_contracts):
     mesh_axes = (eng.model_axes, eng.layout.name)
     # trailing axes: the ISSUE-16 fedbuff variant + staleness exponent
     # (False/0.0 for sync windows), then the ISSUE-17 elastic axes
-    # (capacity tier, mesh node-axis size)
+    # (capacity tier, mesh node-axis size), then the ISSUE-18 cross-host
+    # axes (hosts-axis size, population census — 1/0 on a local engine)
     from tpfl.parallel.mesh import mesh_axis_size
 
     elastic_axes = (int(eng.padded_nodes), mesh_axis_size(eng.mesh))
+    crosshost_axes = (1, 0)
     key_false = (
         "plain", 1, 1, 1, False, False, 0, 0, frac, *mesh_axes,
-        False, 0.0, *elastic_axes,
+        False, 0.0, *elastic_axes, *crosshost_axes,
     )
     key_true = (
         "plain", 1, 1, 1, True, False, 0, 0, frac, *mesh_axes,
-        False, 0.0, *elastic_axes,
+        False, 0.0, *elastic_axes, *crosshost_axes,
     )
     assert key_false in eng._wrapped
     # The seeded key-hygiene bug: the donate=True slot serves the

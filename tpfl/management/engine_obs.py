@@ -125,6 +125,10 @@ def replay_window(
     # absent from pre-codec carries.
     wire = telemetry.get("wire_bytes")
     wire = None if wire is None else np.asarray(wire, np.float64)
+    # Cross-host DCN bytes (the 3D-mesh hosts-leg accounting); absent
+    # from single-host carries.
+    dcn = telemetry.get("dcn_bytes")
+    dcn = None if dcn is None else np.asarray(dcn, np.float64)
     n_rounds = int(loss.shape[0])
     names = list(peers) if peers is not None else peer_names(n_nodes)
     w = None if weights is None else np.asarray(weights, np.float64)
@@ -239,6 +243,13 @@ def replay_window(
         )
         metrics.counter(
             "tpfl_engine_wire_bytes_total", float(wire.sum()), labels=labels
+        )
+    if dcn is not None:
+        metrics.gauge(
+            "tpfl_engine_dcn_bytes", float(dcn[last]), labels=labels
+        )
+        metrics.counter(
+            "tpfl_engine_dcn_bytes_total", float(dcn.sum()), labels=labels
         )
     if flagged:
         metrics.counter(

@@ -29,6 +29,7 @@ round-tripping through pickle on every hop. Here:
 """
 
 from tpfl.parallel.mesh import (
+    HOST_AXIS,
     MODEL_AXIS,
     NODE_AXIS,
     SpecLayout,
@@ -48,8 +49,16 @@ from tpfl.parallel.engine import (
     EngineWindow,
     FederationEngine,
     FedBuffSchedule,
+    resolve_shard_hosts,
     sample_participants,
 )
+from tpfl.parallel.distributed import (
+    ensure_distributed,
+    global_put,
+    is_multiprocess,
+    local_data,
+)
+from tpfl.parallel.population import ClientPopulation
 from tpfl.parallel.federation import VmapFederation
 from tpfl.parallel.federation_learner import FederationLearner
 from tpfl.parallel.window_pipeline import WindowPipeline, WindowPrefetcher
@@ -84,6 +93,7 @@ __all__ = [
     "pad_node_axis",
     "pad_node_weights",
     "shard_stacked",
+    "HOST_AXIS",
     "MODEL_AXIS",
     "NODE_AXIS",
     "SpecLayout",
@@ -94,6 +104,12 @@ __all__ = [
     "FederationEngine",
     "EngineWindow",
     "FedBuffSchedule",
+    "ClientPopulation",
+    "ensure_distributed",
+    "is_multiprocess",
+    "global_put",
+    "local_data",
+    "resolve_shard_hosts",
     "WindowPipeline",
     "WindowPrefetcher",
     "sample_participants",

@@ -1,0 +1,287 @@
+"""Cross-host parity harness: multi-process engine runs on CPU CI.
+
+The 3D engine's acceptance bar (ISSUE 18) is machine-checked parity:
+a 2-process ``jax.distributed`` run of the SAME logical federation
+must land allclose to the single-process run. This module is both
+sides of that check:
+
+- :func:`demo_run` — the shared payload: a small seeded MLP
+  federation driven through :class:`~tpfl.parallel.engine
+  .FederationEngine` on whatever mesh ``auto_mesh()`` resolves under
+  the current ``SHARD_*`` knobs. Every process computes the same
+  host-side inputs (seeded numpy), so the run is reproducible across
+  any process topology; the result is the folded global model (row 0
+  of the unpadded stack), the last round's per-node losses, and a
+  byte digest of the full stack for same-topology determinism checks.
+- :func:`worker_main` — the subprocess entry point
+  (``python -m tpfl.parallel.crosshost``): joins the world via
+  :func:`~tpfl.parallel.distributed.ensure_distributed` (the
+  ``TPFL_COORDINATOR``/``TPFL_NUM_PROCESSES``/``TPFL_PROCESS_ID`` env
+  contract), applies the knob overrides from ``TPFL_CROSSHOST_CFG``,
+  runs :func:`demo_run`, and writes its JSON result to
+  ``<TPFL_CROSSHOST_OUT>.<process_id>.json``.
+- :func:`launch` — the orchestrator tests/bench call in-process: forks
+  N workers with per-process env (``JAX_PLATFORMS=cpu`` and
+  ``--xla_force_host_platform_device_count=K`` BEFORE the child
+  imports jax — the reason this is a subprocess harness at all),
+  waits, and returns their parsed results.
+
+No TPU required anywhere: CPU collectives ride gloo (see
+tpfl/parallel/distributed.py). On a real pod the same ``demo_run``
+executes under the TPU runtime's own coordinator.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+from typing import Any, Optional
+
+import numpy as np
+
+__all__ = ["demo_run", "launch", "worker_main", "free_port"]
+
+#: Knobs a harness config may override in the worker before the run —
+#: a closed set so a config file cannot reach arbitrary settings.
+_KNOBS = (
+    "SHARD_NODES",
+    "SHARD_DEVICES",
+    "SHARD_MODEL",
+    "SHARD_HOSTS",
+    "ENGINE_WIRE_CODEC",
+    "WIRE_TOPK_FRAC",
+    "ENGINE_TELEMETRY",
+    "ENGINE_DONATE",
+)
+
+
+def free_port() -> int:
+    """An OS-assigned free TCP port for the coordinator."""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _apply_knobs(knobs: Optional[dict]) -> None:
+    from tpfl.settings import Settings
+
+    for name, value in (knobs or {}).items():
+        if name not in _KNOBS:
+            raise ValueError(f"crosshost config knob {name!r} not allowed")
+        setattr(Settings, name, value)
+
+
+def demo_run(
+    nodes: int = 8,
+    rounds: int = 2,
+    seed: int = 0,
+    algorithm: str = "fedavg",
+) -> dict:
+    """One deterministic engine federation under the current knobs.
+
+    Same ``(nodes, rounds, seed, algorithm)`` ⇒ the same logical run on
+    ANY topology — 1 process × 8 devices, 2 × 4, forced
+    ``SHARD_HOSTS`` — so results from different worlds are directly
+    comparable (allclose across topologies; byte-equal within one).
+    """
+    import jax
+
+    from tpfl.models import MLP
+    from tpfl.parallel.engine import FederationEngine, auto_mesh
+    from tpfl.parallel.mesh import mesh_axis_size, replicated, HOST_AXIS
+
+    rng = np.random.default_rng(seed)
+    xs = rng.random((nodes, 1, 8, 8, 8), np.float32)
+    ys = rng.integers(0, 10, (nodes, 1, 8)).astype(np.int32)
+    w = np.ones((nodes,), np.float32)
+    w[:: max(nodes // 2, 1)] = 0.0  # partial participation, seeded shape
+    if not w.any():
+        w[:] = 1.0
+
+    mesh = auto_mesh()
+    eng = FederationEngine(
+        MLP(hidden_sizes=(8,)), nodes, mesh=mesh, seed=seed,
+        algorithm=algorithm, learning_rate=0.1,
+    )
+    p = eng.init_params((8, 8))
+    dx, dy = eng.shard_data(xs, ys)
+    p, losses = eng.run_rounds(
+        p, dx, dy, weights=w, n_rounds=rounds, donate=False
+    )
+
+    def fetch(x: Any) -> np.ndarray:
+        # Multi-process outputs are global (not fully addressable):
+        # all-gather through an identity jit onto the replicated
+        # sharding, then read the local copy.
+        if hasattr(x, "is_fully_addressable") and not x.is_fully_addressable:
+            x = jax.jit(lambda a: a, out_shardings=replicated(eng.mesh))(x)
+            x = x.addressable_data(0)
+        return np.asarray(x)
+
+    stack = jax.tree_util.tree_map(fetch, eng.unpad(p))
+    leaves = jax.tree_util.tree_leaves(stack)
+    global_row = np.concatenate(
+        [leaf[0].astype(np.float64).ravel() for leaf in leaves]
+    )
+    import hashlib
+
+    from tpfl.learning.serialization import leaf_bytes
+
+    h = hashlib.sha256()
+    for leaf in leaves:
+        h.update(leaf_bytes(leaf))
+    digest = h.hexdigest()
+    # The cross-host receipt: bytes the DCN leg ships per round under
+    # the active codec — hosts × codec'd-model bytes, the exact
+    # constant the telemetry carry's dcn_bytes row records
+    # (tests/test_crosshost.py pins carry == constant; the bench gates
+    # the dense/quant8 ratio on this).
+    from tpfl.learning import compression
+
+    hosts = mesh_axis_size(mesh, HOST_AXIS) if mesh is not None else 1
+    dcn_bytes = 0
+    if hosts > 1:
+        _, bits, frac = eng._resolve_variant()
+        dcn_bytes = hosts * compression.wire_bytes_per_model(
+            jax.tree_util.tree_map(
+                lambda t: jax.ShapeDtypeStruct(t.shape[1:], t.dtype), p
+            ),
+            bits,
+            frac,
+        )
+    return {
+        "loss_mean": float(np.mean(fetch(losses)[:nodes])),
+        "dcn_bytes_per_round": int(dcn_bytes),
+        "global": global_row.tolist(),
+        "losses": fetch(losses)[:nodes].astype(np.float64).tolist(),
+        "digest": digest,
+        "devices": len(jax.devices()),
+        "local_devices": len(jax.local_devices()),
+        "processes": jax.process_count(),
+        "process_id": jax.process_index(),
+        "hosts_axis": mesh_axis_size(mesh, HOST_AXIS) if mesh else 1,
+        "mesh": dict(
+            zip(mesh.axis_names, mesh.devices.shape)
+        ) if mesh is not None else None,
+    }
+
+
+def worker_main() -> int:
+    """Subprocess body: join the world, run the demo, write JSON."""
+    # Join BEFORE touching anything that initializes jax backends —
+    # jax.distributed.initialize must precede device queries.
+    from tpfl.parallel.distributed import ensure_distributed
+
+    ensure_distributed()
+    cfg = json.loads(os.environ.get("TPFL_CROSSHOST_CFG", "{}") or "{}")
+    _apply_knobs(cfg.get("knobs"))
+    result = demo_run(
+        nodes=int(cfg.get("nodes", 8)),
+        rounds=int(cfg.get("rounds", 2)),
+        seed=int(cfg.get("seed", 0)),
+        algorithm=str(cfg.get("algorithm", "fedavg")),
+    )
+    out = os.environ.get("TPFL_CROSSHOST_OUT")
+    if out:
+        path = f"{out}.{result['process_id']}.json"
+        with open(path, "w") as f:
+            json.dump(result, f)
+    else:  # pragma: no cover - manual runs
+        print(json.dumps(result))
+    return 0
+
+
+def launch(
+    num_processes: int = 2,
+    devices_per_proc: int = 4,
+    nodes: int = 8,
+    rounds: int = 2,
+    seed: int = 0,
+    algorithm: str = "fedavg",
+    knobs: Optional[dict] = None,
+    timeout: float = 420.0,
+) -> list[dict]:
+    """Fork ``num_processes`` gloo workers and return their results.
+
+    Each child gets ``devices_per_proc`` forced virtual CPU devices
+    and joins a fresh coordinator on a free localhost port; the parent
+    never initializes jax.distributed itself (its own backend state is
+    untouched). Raises on any worker failure, with the worker's
+    stderr tail in the message — the CI failure must say WHY a rank
+    died, not just that it did.
+    """
+    port = free_port()
+    out_prefix = os.path.join(
+        tempfile.mkdtemp(prefix="tpfl_crosshost_"), "result"
+    )
+    # Children must see the forced device count BEFORE importing jax:
+    # scrub any inherited force flag (the parent test process runs
+    # under conftest's 8-device XLA_FLAGS) and set our own.
+    xla_flags = " ".join(
+        tok
+        for tok in os.environ.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in tok
+    )
+    cfg = json.dumps(
+        {
+            "nodes": nodes,
+            "rounds": rounds,
+            "seed": seed,
+            "algorithm": algorithm,
+            "knobs": dict(knobs or {}),
+        }
+    )
+    procs = []
+    for pid in range(num_processes):
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS=(
+                f"{xla_flags} "
+                f"--xla_force_host_platform_device_count={devices_per_proc}"
+            ).strip(),
+            TPFL_COORDINATOR=f"127.0.0.1:{port}",
+            TPFL_NUM_PROCESSES=str(num_processes),
+            TPFL_PROCESS_ID=str(pid),
+            TPFL_CROSSHOST_OUT=out_prefix,
+            TPFL_CROSSHOST_CFG=cfg,
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-m", "tpfl.parallel.crosshost"],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+    failures = []
+    for pid, proc in enumerate(procs):
+        try:
+            _, err = proc.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            _, err = proc.communicate()
+            failures.append(f"rank {pid}: timeout\n{err[-2000:]}")
+            continue
+        if proc.returncode != 0:
+            failures.append(
+                f"rank {pid}: exit {proc.returncode}\n{err[-2000:]}"
+            )
+    if failures:
+        raise RuntimeError(
+            "crosshost workers failed:\n" + "\n---\n".join(failures)
+        )
+    results = []
+    for pid in range(num_processes):
+        with open(f"{out_prefix}.{pid}.json") as f:
+            results.append(json.load(f))
+    return results
+
+
+if __name__ == "__main__":  # pragma: no cover - subprocess entry
+    sys.exit(worker_main())

@@ -110,7 +110,9 @@ from tpfl.learning.jax_learner import (
 )
 from tpfl.management import profiling
 from tpfl.parallel.compat import shard_map
+from tpfl.parallel.distributed import global_put, is_multiprocess
 from tpfl.parallel.mesh import (
+    HOST_AXIS,
     MODEL_AXIS,
     NODE_AXIS,
     SpecLayout,
@@ -119,6 +121,8 @@ from tpfl.parallel.mesh import (
     global_model_shardings,
     layout_for_module,
     mesh_axis_size,
+    node_shard_dims,
+    node_shard_size,
     pad_node_axis,
     pad_node_weights,
     padded_node_count,
@@ -146,47 +150,74 @@ TELEMETRY_FIELDS = TELEMETRY_NODE_FIELDS + TELEMETRY_ROUND_FIELDS
 #: what ``engine_obs.replay_window`` feeds the ledger's staleness
 #: column and the AsyncController's arrival observations.
 TELEMETRY_STALENESS_FIELD = "staleness"
+#: Extra per-round carry row of cross-host (3D-mesh) programs: the
+#: round's DCN payload bytes — the per-host partial aggregates that
+#: cross the ``hosts`` axis, under the active ENGINE_WIRE_CODEC.
+TELEMETRY_DCN_FIELD = "dcn_bytes"
 
 
 # --- auto mesh resolution (Settings.SHARD_* knobs) -----------------------
 
 # unguarded: process-wide memo of immutable Mesh objects keyed by
-# (device count, model-axis size); worst case under a race is building
-# the same Mesh twice.
-_auto_meshes: dict[tuple[int, int], Mesh] = {}
+# (device count, model-axis size, hosts-axis size); worst case under a
+# race is building the same Mesh twice.
+_auto_meshes: dict[tuple[int, int, int], Mesh] = {}
 
 
 def shard_device_count() -> int:
     """Devices the SHARD_* knobs allow the engine to spread over:
-    0 (default) = all local devices, else min(knob, available)."""
+    0 (default) = all devices (GLOBAL — across every process of a
+    jax.distributed world), else min(knob, available)."""
     n = len(jax.devices())
     cap = int(Settings.SHARD_DEVICES)
     return n if cap <= 0 else min(cap, n)
 
 
+def resolve_shard_hosts() -> int:
+    """The ``hosts`` axis size the ``SHARD_HOSTS`` knob selects:
+    1 = off (the single-host layout), 0 = auto — one slot per
+    participating process (``jax.process_count()``; 1 for a lone
+    process, so auto is a no-op outside a jax.distributed world),
+    H > 1 = forced (valid single-process too: the hosts axis then
+    spans local devices, the CI parity harness's trick)."""
+    h = int(Settings.SHARD_HOSTS)
+    if h == 0:
+        h = jax.process_count()
+    return max(1, h)
+
+
 def auto_mesh() -> Optional[Mesh]:
     """The mesh the ``SHARD_NODES`` knobs select: all allowed local
     devices on one ``nodes`` axis (``SHARD_MODEL`` = 1, the default —
-    byte-identical programs to the pre-2D path), or the 2D
+    byte-identical programs to the pre-2D path), the 2D
     ``nodes x model`` mesh when ``SHARD_MODEL`` = M > 1 (``nodes`` =
-    devices / M; M must divide). None when sharding is off or there is
-    only one device."""
+    devices / M; M must divide), and/or the 3D ``hosts x nodes
+    [x model]`` mesh when ``SHARD_HOSTS`` resolves above 1
+    (:func:`resolve_shard_hosts`) — the hosts axis leads, so each
+    process' devices form one contiguous hosts-row and cross-host
+    collectives ride DCN. None when sharding is off or there is only
+    one device."""
     if not Settings.SHARD_NODES:
         return None
     d = shard_device_count()
     if d <= 1:
         return None
     m = max(1, int(Settings.SHARD_MODEL))
-    if d % m != 0:
+    h = resolve_shard_hosts()
+    if d % (m * h) != 0:
         raise ValueError(
-            f"SHARD_MODEL={m} does not divide the {d} allowed devices"
+            f"SHARD_MODEL={m} x SHARD_HOSTS={h} does not divide the "
+            f"{d} allowed devices"
         )
-    mesh = _auto_meshes.get((d, m))
+    mesh = _auto_meshes.get((d, m, h))
     if mesh is None:
-        axes = {NODE_AXIS: d // m}
+        axes = {}
+        if h > 1:
+            axes[HOST_AXIS] = h
+        axes[NODE_AXIS] = d // (m * h)
         if m > 1:
             axes[MODEL_AXIS] = m
-        mesh = _auto_meshes[(d, m)] = create_mesh(
+        mesh = _auto_meshes[(d, m, h)] = create_mesh(
             axes, devices=jax.devices()[:d]
         )
     return mesh
@@ -196,9 +227,11 @@ def maybe_nodes_mesh(width: int) -> Optional[Mesh]:
     """Mesh for sharding a batched node axis of ``width`` rows (the
     batched-fit pool's chunk), or None when sharding is off, there is
     one device, or ``width`` does not divide — the pool's power-of-two
-    bucketing makes divisibility the common case on 2^k-chip hosts."""
+    bucketing makes divisibility the common case on 2^k-chip hosts.
+    On a 3D mesh the node axis shards over ``hosts x nodes`` combined,
+    so that product is the divisor."""
     mesh = auto_mesh()
-    if mesh is None or width % mesh_axis_size(mesh) != 0:
+    if mesh is None or width % node_shard_size(mesh) != 0:
         return None
     return mesh
 
@@ -449,14 +482,26 @@ class EngineWindow:
             profiling.rounds.add(self._node_tag, "train", t2 - self._t1,
                                  round=self._ordinal)
             profiling.rounds.end_round(self._node_tag, self._ordinal)
-        if self._tele is not None:
+        tele = self._tele
+        if tele is not None and any(
+            hasattr(v, "is_fully_addressable") and not v.is_fully_addressable
+            for v in tele.values()
+        ):
+            # Multi-process window: the per-node telemetry rows are
+            # sharded across processes, so no process holds the full
+            # window — the observatory fan-out is a single-host plane
+            # (documented in docs/scaling.md); run cross-host windows
+            # with ENGINE_TELEMETRY off, or read the per-process
+            # registry series instead.
+            tele = None
+        if tele is not None:
             # One host sync per WINDOW — and when the non-blocking D2H
             # copy (started at dispatch) has landed, not even that:
             # np.asarray reads the host-resident buffer.
             from tpfl.management import engine_obs
 
             eng = self._engine
-            host_tele = {k: np.asarray(v) for k, v in self._tele.items()}
+            host_tele = {k: np.asarray(v) for k, v in tele.items()}
             engine_obs.replay_window(
                 self._node_tag,
                 profiling.module_tag(eng.module),
@@ -537,6 +582,17 @@ def _sequence_parallel_module(module: Any, mesh: Mesh) -> Any:
         return fn(q, k, v)
 
     return module.clone(attention_fn=model_ring_attention)
+
+
+def _round_node_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for per-round per-node ``[n_rounds, nodes]`` arrays
+    (weights / attack scales / fedbuff masks): rounds replicated, the
+    node axis over the mesh's node-shard dims (``hosts x nodes`` on a
+    3D mesh — the same placement as the stacked state)."""
+    dims = node_shard_dims(mesh)
+    return NamedSharding(
+        mesh, PartitionSpec(None, dims if len(dims) > 1 else dims[0])
+    )
 
 
 # --- the engine ----------------------------------------------------------
@@ -648,6 +704,12 @@ class FederationEngine:
         #: engine's node axis at the view's capacity tier. None
         #: (default) = fixed membership.
         self.membership: Optional[Any] = None
+        #: Optional ClientPopulation (tpfl.parallel.population): the
+        #: cross-device tier — this engine's resident nodes become
+        #: edge aggregators and each round's cohort is sampled from
+        #: the registered census (attach_population). None (default)
+        #: = every logical node is resident.
+        self.population: Optional[Any] = None
         #: [padded_nodes] 1/0 mask of real vs pad rows (the uniform
         #: fallback denominator when a round's weights are all-zero).
         self.valid = valid_node_mask(self.n_nodes, self.padded_nodes)
@@ -662,22 +724,26 @@ class FederationEngine:
 
     def _shard(self, tree: Any) -> Any:
         """Node-axis placement for node-stacked DATA (model-axis
-        replicated — every model shard sees the node's full batch)."""
+        replicated — every model shard sees the node's full batch).
+        ``global_put`` == ``jax.device_put`` single-process; in a
+        multi-process world each process contributes its addressable
+        shards of the global array."""
         if self.mesh is None:
             return tree
-        return jax.device_put(tree, federation_sharding(self.mesh))
+        return global_put(tree, federation_sharding(self.mesh))
 
     def _shard_state(self, tree: Any) -> Any:
         """Per-leaf placement for node-stacked MODEL STATE (params /
-        variates / aux): the node axis over ``nodes`` and, on a 2D
-        mesh, each leaf's model dims over ``model`` per the layout."""
+        variates / aux): the node axis over ``nodes`` (``hosts x
+        nodes`` on 3D meshes) and, on a 2D mesh, each leaf's model
+        dims over ``model`` per the layout."""
         if self.mesh is None:
             return tree
         if self.model_axes > 1:
-            return jax.device_put(
+            return global_put(
                 tree, stacked_model_shardings(self.mesh, tree, self.layout)
             )
-        return jax.device_put(tree, federation_sharding(self.mesh))
+        return global_put(tree, federation_sharding(self.mesh))
 
     def _shard_global(self, tree: Any) -> Any:
         """Placement for UNSTACKED node-replicated state (SCAFFOLD's
@@ -686,10 +752,10 @@ class FederationEngine:
         if self.mesh is None:
             return tree
         if self.model_axes > 1:
-            return jax.device_put(
+            return global_put(
                 tree, global_model_shardings(self.mesh, tree, self.layout)
             )
-        return jax.device_put(tree, replicated(self.mesh))
+        return global_put(tree, replicated(self.mesh))
 
     def init_state(self, input_shape: tuple[int, ...]) -> tuple[Any, Any]:
         """(stacked params, stacked aux) on the padded node axis — aux
@@ -809,6 +875,23 @@ class FederationEngine:
         if int(view.capacity) != self.n_nodes:
             self.resize_nodes(int(view.capacity))
 
+    def attach_population(self, population: Any) -> None:
+        """Drive this engine from a
+        :class:`~tpfl.parallel.population.ClientPopulation`: the
+        engine's resident nodes become the cross-device tier's edge
+        aggregators, each window's cohort comes from the population's
+        seeded per-round sample (``population.begin_round``), and the
+        registered census becomes a program-cache / contract axis of
+        the round programs (``pop_size``) — attaching or resizing a
+        population selects fresh cache slots, never mutates a
+        compiled program. The sampled cohort must fit the engine's
+        node axis: ``population.sample`` (+ edge residents) rows are
+        stacked via :meth:`broadcast_params`, so live state stays
+        O(sampled) regardless of the census."""
+        self.population = population
+        if population is not None:
+            population.bind(self)
+
     def sync_membership(self) -> bool:
         """Re-align the node axis with the attached view's tier (after
         its ``join``-driven promotions or ``maybe_resize`` demotions,
@@ -847,15 +930,33 @@ class FederationEngine:
         callers snapshot OFF the critical path (the window pipeline
         rides the ``copy_to_host_async`` host leg)."""
 
+        n = self.n_nodes
+
+        def fetch(x: Any) -> np.ndarray:
+            # host-sync: checkpoint consumption boundary (see above).
+            # np.array, not np.asarray: on the CPU backend asarray is
+            # a ZERO-COPY view of the device buffer, and a later
+            # donating round may overwrite that buffer in place
+            # (deserialized persistent-cache executables do) — the
+            # checkpoint must own its bytes. Cross-host arrays are
+            # replicated first through an identity dispatch (one
+            # all-gather over DCN), so every process owns the full
+            # logical rows and checkpoints stay mesh-agnostic.
+            if (
+                hasattr(x, "is_fully_addressable")
+                and not x.is_fully_addressable
+            ):
+                x = jax.jit(
+                    lambda a: a, out_shardings=replicated(self.mesh)
+                )(x)
+                x = x.addressable_data(0)
+            # host-sync: checkpoint consumption boundary — export_state
+            # runs between windows, never inside the dispatch loop.
+            return np.array(x)
+
         def host(tree: Any) -> Any:
             return jax.tree_util.tree_map(
-                # host-sync: checkpoint consumption boundary (see
-                # above). np.array, not np.asarray: on the CPU backend
-                # asarray is a ZERO-COPY view of the device buffer, and
-                # a later donating round may overwrite that buffer in
-                # place (deserialized persistent-cache executables do)
-                # — the checkpoint must own its bytes.
-                lambda x: np.array(x), self.unpad(tree)
+                lambda x: fetch(x)[:n], tree
             )
 
         state: dict = {
@@ -870,15 +971,17 @@ class FederationEngine:
         if scaffold_state is not None:
             c_locals, c_global = scaffold_state
             state["c_locals"] = host(c_locals)
-            state["c_global"] = jax.tree_util.tree_map(
-                # host-sync: checkpoint consumption boundary (owning
-                # copy — see host()).
-                lambda x: np.array(x), c_global
-            )
+            # host-sync: checkpoint consumption boundary (owning copy
+            # — see fetch(); unstacked, so no row slice).
+            state["c_global"] = jax.tree_util.tree_map(fetch, c_global)
         if self.controller is not None:
             state["controller"] = self.controller.state_export()
         if self.membership is not None:
             state["membership"] = self.membership.state_export()
+        if self.population is not None:
+            # O(active): only touched clients' records ride the
+            # snapshot (tpfl.parallel.population), never the census.
+            state["population"] = self.population.state_export()
         if quarantine is not None:
             state["quarantine"] = quarantine.state_export()
         return state
@@ -924,6 +1027,16 @@ class FederationEngine:
                 )
             else:
                 self.membership.state_import(state["membership"])
+        if state.get("population"):
+            if self.population is None:
+                from tpfl.parallel.population import ClientPopulation
+
+                self.population = ClientPopulation.from_state(
+                    state["population"]
+                )
+                self.population.bind(self)
+            else:
+                self.population.state_import(state["population"])
         if quarantine is not None and state.get("quarantine"):
             quarantine.state_import(state["quarantine"])
         return out
@@ -1043,32 +1156,52 @@ class FederationEngine:
         return local_train
 
     @staticmethod
-    def _fold_weights(weights, valid, psum_axis):
+    def _fold_weights(weights, valid, psum_axis, host_axis=None):
         """Normalized fold weights: ``weights / Σweights`` with a
         uniform-over-REAL-nodes fallback when all-zero (pad rows never
         enter the fallback). Sums are global — on a sharded mesh each
         device's partial sum is psum-reduced over the ``nodes`` axis
-        (the first collective of the gossip exchange)."""
+        (the first collective of the gossip exchange), then over
+        ``hosts`` on a 3D mesh (scalar DCN traffic — the weight mass
+        never rides a codec)."""
         total = jnp.sum(weights)
         valid_total = jnp.sum(valid)
         if psum_axis is not None:
             total = lax.psum(total, psum_axis)
             valid_total = lax.psum(valid_total, psum_axis)
+        if host_axis is not None:
+            total = lax.psum(total, host_axis)
+            valid_total = lax.psum(valid_total, host_axis)
         fallback = valid / jnp.maximum(valid_total, 1.0)
         return jnp.where(
             total > 0, weights / jnp.maximum(total, 1e-9), fallback
         )
 
-    def _build_fold(self, kind: str, psum_axis: Optional[str]) -> Callable:
+    def _build_fold(
+        self, kind: str, psum_axis: Optional[str],
+        host_axis: Optional[str] = None,
+        dcn_codec: Optional[Callable] = None,
+    ) -> Callable:
         """Masked FedAvg fold + full-model diffusion (+ the SCAFFOLD
         server update / aux aggregation). ``psum_axis`` None = the
         single-program einsum over the whole node axis (the legacy
         ``VmapFederation`` reduction); set = per-device partial sums
-        all-reduced by ``lax.psum`` — gossip as a mesh collective."""
+        all-reduced by ``lax.psum`` — gossip as a mesh collective.
+
+        ``host_axis`` (3D meshes) decomposes the reduction in two
+        legs: the ``nodes`` psum folds each host's local partial over
+        ICI, then the partial aggregates all-reduce over ``hosts`` —
+        the DCN leg. Exact at any host count: a psum over a product of
+        axes equals psums over each in sequence. ``dcn_codec`` (the
+        ENGINE_WIRE_CODEC lowered onto DCN) round-trips each host's
+        PARAMS partial through the wire codec between the two legs, so
+        the cross-host traffic ships int8/sparse natively — params
+        only, like the node-level exchange codec: SCAFFOLD variates
+        and aux stats cross dense."""
         aux_mode = self.aux_mode
         n_logical = self.n_nodes
 
-        def leaf_mean_of(wnorm):
+        def leaf_mean_of(wnorm, on_wire=False):
             def leaf_mean(p):
                 w = wnorm.astype(jnp.float32)
                 # Masked-out (w=0) nodes are zeroed BEFORE the
@@ -1079,12 +1212,19 @@ class FederationEngine:
                 agg = jnp.einsum("n,n...->...", w, clean)
                 if psum_axis is not None:
                     agg = lax.psum(agg, psum_axis)
+                if host_axis is not None:
+                    if on_wire and dcn_codec is not None:
+                        # The host's partial aggregate passes the wire
+                        # round-trip BEFORE the DCN all-reduce — every
+                        # peer host folds what the wire would deliver.
+                        agg = dcn_codec(agg)
+                    agg = lax.psum(agg, host_axis)
                 return agg.astype(p.dtype)
 
             return leaf_mean
 
-        def diffuse(tree, wnorm, n_local):
-            leaf_mean = leaf_mean_of(wnorm)
+        def diffuse(tree, wnorm, n_local, on_wire=False):
+            leaf_mean = leaf_mean_of(wnorm, on_wire)
             agg = jax.tree_util.tree_map(leaf_mean, tree)
             # Every node receives the aggregate (the FullModelCommand
             # equivalent of the protocol path) — on a mesh this is the
@@ -1096,8 +1236,8 @@ class FederationEngine:
         def fold(trained, new_c, new_aux, c_locals, c_global, aux, weights,
                  valid):
             n_local = weights.shape[0]
-            wnorm = self._fold_weights(weights, valid, psum_axis)
-            out_params = diffuse(trained, wnorm, n_local)
+            wnorm = self._fold_weights(weights, valid, psum_axis, host_axis)
+            out_params = diffuse(trained, wnorm, n_local, on_wire=True)
             sel = weights > 0
 
             def keep_elected(new, old):
@@ -1114,7 +1254,9 @@ class FederationEngine:
                 elected = jnp.sum(mask)
                 if psum_axis is not None:
                     elected = lax.psum(elected, psum_axis)
-                um = self._fold_weights(mask, valid, psum_axis)
+                if host_axis is not None:
+                    elected = lax.psum(elected, host_axis)
+                um = self._fold_weights(mask, valid, psum_axis, host_axis)
                 uniform_mean = leaf_mean_of(um)
                 frac = elected / n_logical
                 out_cg = jax.tree_util.tree_map(
@@ -1224,12 +1366,22 @@ class FederationEngine:
         # would re-derive what the partitioner already proves).
         sharded = (
             mesh is not None
-            and mesh_axis_size(mesh) > 1
+            and node_shard_size(mesh) > 1
             and self.model_axes <= 1
         )
         psum_axis = NODE_AXIS if sharded else None
-        fold = self._build_fold(kind, psum_axis)
+        # 3D meshes split the fold's reduction in two legs: nodes
+        # (ICI, above) then hosts (DCN) — with the wire codec lowered
+        # onto the DCN leg (see _build_fold). hosts == 1 leaves
+        # host_axis None, so every cross-host branch below is elided
+        # at the Python level and 1D/2D programs lower byte-identical
+        # to the single-host engine.
+        hosts = mesh_axis_size(mesh, HOST_AXIS) if sharded else 1
+        host_axis = HOST_AXIS if hosts > 1 else None
         codec_fn = compression.engine_codec_roundtrip(codec, topk_frac)
+        fold = self._build_fold(
+            kind, psum_axis, host_axis, codec_fn if codec else None
+        )
         f32 = jnp.float32
 
         def per_node_sq(tree):
@@ -1253,7 +1405,11 @@ class FederationEngine:
             return total
 
         def psum_(x):
-            return lax.psum(x, psum_axis) if psum_axis is not None else x
+            if psum_axis is not None:
+                x = lax.psum(x, psum_axis)
+            if host_axis is not None:
+                x = lax.psum(x, host_axis)
+            return x
 
         def masked_mean(x, valid):
             num = psum_(jnp.sum(x * valid))
@@ -1382,6 +1538,12 @@ class FederationEngine:
                     "weight_mass": psum_(jnp.sum(w.astype(f32))),
                     "wire_bytes": participation * f32(bpm),
                 }
+                if host_axis is not None:
+                    # The DCN leg ships ONE model-shaped partial per
+                    # host per round (the fold's cross-host
+                    # all-reduce), codec'd like the node exchange —
+                    # same per-model bytes constant, hosts copies.
+                    round_stats["dcn_bytes"] = f32(hosts) * f32(bpm)
                 return (
                     out_params, out_c, out_cg, out_aux, losses,
                     (node_stats, round_stats),
@@ -1407,6 +1569,8 @@ class FederationEngine:
                     "wire_bytes": per_round,
                 }
             )
+            if host_axis is not None:
+                tele["dcn_bytes"] = per_round
             return tele
 
         def tele_write(tele, r, losses, node_stats, round_stats):
@@ -1476,9 +1640,17 @@ class FederationEngine:
         if not sharded:
             return multi
 
-        node = PartitionSpec(NODE_AXIS)
+        if host_axis is not None:
+            # 3D mesh: the stacked node axis shards over hosts x nodes
+            # combined — each host's devices hold a contiguous run of
+            # logical nodes (the same placement federation_sharding
+            # commits the buffers to).
+            node = PartitionSpec((HOST_AXIS, NODE_AXIS))
+            rn = PartitionSpec(None, (HOST_AXIS, NODE_AXIS))
+        else:
+            node = PartitionSpec(NODE_AXIS)
+            rn = PartitionSpec(None, NODE_AXIS)
         repl = PartitionSpec()
-        rn = PartitionSpec(None, NODE_AXIS)
         w_spec = node if w_ndim == 1 else rn
         in_specs = [node, node, repl, node, node, node, w_spec, node]
         if a_ndim:
@@ -1499,6 +1671,8 @@ class FederationEngine:
             }
             if fedbuff:
                 tele_specs["staleness"] = rn
+            if host_axis is not None:
+                tele_specs["dcn_bytes"] = repl
             out_specs = out_specs + (tele_specs,)
         return shard_map(
             multi,
@@ -1557,7 +1731,7 @@ class FederationEngine:
         ns = federation_sharding(mesh)
         out_sh: tuple = (in_sh[0], in_sh[1], in_sh[2], in_sh[3], ns)
         if telemetry:
-            rn = NamedSharding(mesh, PartitionSpec(None, NODE_AXIS))
+            rn = _round_node_sharding(mesh)
             rs = replicated(mesh)
             tele_sh = {
                 "loss": rn,
@@ -1581,11 +1755,15 @@ class FederationEngine:
         model_axes: int = 1, layout: str = "replicated",
         fedbuff: bool = False, stale_exp: float = 0.0,
         capacity: int = 0, mesh_nodes: int = 1,
+        mesh_hosts: int = 1, pop_size: int = 0,
     ) -> Callable:
-        # capacity / mesh_nodes are pure cache-key axes: the padded
-        # tier and mesh shape already determine the abstract shapes
-        # and the shard_map lowering this build closes over.
-        del capacity, mesh_nodes
+        # capacity / mesh_nodes / mesh_hosts / pop_size are pure
+        # cache-key axes: the padded tier and mesh shape (hosts axis
+        # included) already determine the abstract shapes and the
+        # shard_map lowering this build closes over, and the
+        # population census determines the sampled cohort the caller
+        # stacked — none re-enters the trace.
+        del capacity, mesh_nodes, mesh_hosts, pop_size
         multi = self._build_multi(
             kind, epochs, n_rounds, w_ndim, telemetry, a_ndim, codec,
             topk_frac, fedbuff, stale_exp,
@@ -1593,7 +1771,7 @@ class FederationEngine:
         dn = (0, 1, 2, 3) if donate else ()
         mesh = self.mesh
         if mesh is None or (
-            mesh_axis_size(mesh) <= 1 and self.model_axes <= 1
+            node_shard_size(mesh) <= 1 and self.model_axes <= 1
         ):
             return jax.jit(multi, donate_argnums=dn)
         if self.model_axes > 1:
@@ -1611,7 +1789,7 @@ class FederationEngine:
             )
         ns = federation_sharding(mesh)
         rs = replicated(mesh)
-        rn = NamedSharding(mesh, PartitionSpec(None, NODE_AXIS))
+        rn = _round_node_sharding(mesh)
         ws = ns if w_ndim == 1 else rn
         in_sh = [ns, ns, rs, ns, ns, ns, ws, ns]
         if a_ndim:
@@ -1632,6 +1810,8 @@ class FederationEngine:
             }
             if fedbuff:
                 tele_sh["staleness"] = rn
+            if mesh_axis_size(mesh, HOST_AXIS) > 1:
+                tele_sh["dcn_bytes"] = rs
             out_sh = out_sh + (tele_sh,)
         return jax.jit(
             multi,
@@ -1647,6 +1827,7 @@ class FederationEngine:
         model_axes: int = 1, layout: str = "replicated",
         fedbuff: bool = False, stale_exp: float = 0.0,
         capacity: int = 0, mesh_nodes: int = 1,
+        mesh_hosts: int = 1, pop_size: int = 0,
     ) -> Callable:
         """Cached compiled program for ``(kind, epochs, n_rounds,
         w_ndim)`` — the raw jitted callable (bench drives these from
@@ -1676,12 +1857,19 @@ class FederationEngine:
         closed over) make the elastic/resume contract explicit in the
         key: a tier promotion or a restore onto a different mesh shape
         selects its own slot — and DEMOTING back to a seen tier is a
-        cache hit, so tier oscillation compiles each tier once."""
+        cache hit, so tier oscillation compiles each tier once.
+        ``mesh_hosts``/``pop_size`` (the ISSUE-18 cross-host /
+        cross-device axes: the mesh's ``hosts``-axis size the
+        two-level psum lowering closed over, and the registered
+        population census the dispatched cohort was sampled from)
+        follow the same discipline — a hosts-axis change or a
+        population attach/detach selects its own slot."""
         key = (
             kind, int(epochs), int(n_rounds), int(w_ndim), bool(donate),
             bool(telemetry), int(a_ndim), int(codec), float(topk_frac),
             int(model_axes), str(layout), bool(fedbuff), float(stale_exp),
             int(capacity), int(mesh_nodes),
+            int(mesh_hosts), int(pop_size),
         )
         fn = self._programs.get(key)
         profiling.observatory.cache_event("engine_programs", hit=fn is not None)
@@ -1696,18 +1884,20 @@ class FederationEngine:
         model_axes: int = 1, layout: str = "replicated",
         fedbuff: bool = False, stale_exp: float = 0.0,
         capacity: int = 0, mesh_nodes: int = 1,
+        mesh_hosts: int = 1, pop_size: int = 0,
     ) -> Callable:
         """The same program behind the compile observatory's recompile
         detection (keyed per (engine program, abstract shapes) like
         every other jit seam). Variant programs get their own names —
-        the telemetry/attack/codec/2D-mesh/fedbuff (and capacity-tier)
-        signatures differ by construction and must not read as
-        recompile storms of the base program."""
+        the telemetry/attack/codec/2D-mesh/fedbuff (and capacity-tier
+        / hosts-axis / population) signatures differ by construction
+        and must not read as recompile storms of the base program."""
         key = (
             kind, int(epochs), int(n_rounds), int(w_ndim), bool(donate),
             bool(telemetry), int(a_ndim), int(codec), float(topk_frac),
             int(model_axes), str(layout), bool(fedbuff), float(stale_exp),
             int(capacity), int(mesh_nodes),
+            int(mesh_hosts), int(pop_size),
         )
         fn = self._wrapped.get(key)
         if fn is None:
@@ -1718,6 +1908,8 @@ class FederationEngine:
                 + (f":m{int(model_axes)}" if int(model_axes) > 1 else "")
                 + (":fb" if fedbuff else "")
                 + (f":c{int(capacity)}" if capacity else "")
+                + (f":h{int(mesh_hosts)}" if int(mesh_hosts) > 1 else "")
+                + (f":pop{int(pop_size)}" if pop_size else "")
             )
             wrapped = profiling.observatory.wrap(
                 self.program(*key),
@@ -1744,6 +1936,8 @@ class FederationEngine:
                     # so the contract stays total without forcing the
                     # sync path to track an async-only knob.
                     "ASYNC_STALENESS_EXP": float(stale_exp),
+                    "SHARD_HOSTS": int(mesh_hosts),
+                    "POPULATION_CLIENTS": int(pop_size),
                 },
             )
         return fn
@@ -1846,29 +2040,28 @@ class FederationEngine:
         a = {} if aux is None else self._shard_state(self.pad_stacked(aux))
         valid = self.valid
         if self.mesh is not None:
-            w = jax.device_put(
+            w = global_put(
                 w,
                 federation_sharding(self.mesh)
                 if w.ndim == 1
-                else NamedSharding(self.mesh, PartitionSpec(None, NODE_AXIS)),
+                else _round_node_sharding(self.mesh),
             )
             if scales is not None:
-                scales = jax.device_put(
+                scales = global_put(
                     scales,
                     federation_sharding(self.mesh)
                     if scales.ndim == 1
-                    else NamedSharding(
-                        self.mesh, PartitionSpec(None, NODE_AXIS)
-                    ),
+                    else _round_node_sharding(self.mesh),
                 )
-            if self.model_axes > 1:
-                valid = jax.device_put(valid, federation_sharding(self.mesh))
+            if self.model_axes > 1 or is_multiprocess():
+                # Multi-process runs place EVERY input explicitly:
+                # a host-resident array reaching a jit whose sharding
+                # spans non-addressable devices cannot be auto-placed.
+                valid = global_put(valid, federation_sharding(self.mesh))
             if arrivals is not None:
-                rn_sh = NamedSharding(
-                    self.mesh, PartitionSpec(None, NODE_AXIS)
-                )
-                arrivals = jax.device_put(arrivals, rn_sh)
-                taus = jax.device_put(taus, rn_sh)
+                rn_sh = _round_node_sharding(self.mesh)
+                arrivals = global_put(arrivals, rn_sh)
+                taus = global_put(taus, rn_sh)
         args = [params, c_locals, c_global, a, xs, ys, w, valid]
         if scales is not None:
             args.append(scales)
@@ -1914,6 +2107,11 @@ class FederationEngine:
             model_axes=self.model_axes, layout=self.layout.name,
             capacity=int(self.padded_nodes),
             mesh_nodes=mesh_axis_size(self.mesh),
+            mesh_hosts=mesh_axis_size(self.mesh, HOST_AXIS),
+            pop_size=(
+                0 if self.population is None
+                else int(self.population.registered)
+            ),
         )
         return donation_analysis(fn, tuple(args))
 
@@ -2044,13 +2242,20 @@ class FederationEngine:
         # the padded capacity tier this window is shaped for, and the
         # mesh's node-axis size the lowering closed over — a tier
         # promotion or a restore onto another mesh shape must select
-        # its own cache slot, never mutate a compiled program.
+        # its own cache slot, never mutate a compiled program. The
+        # cross-host / cross-device axes follow suit: the hosts-axis
+        # size the two-level psum closed over, and the registered
+        # population census the window's cohort was sampled from.
         capacity = int(self.padded_nodes)
         mesh_nodes = mesh_axis_size(self.mesh)
+        mesh_hosts = mesh_axis_size(self.mesh, HOST_AXIS)
+        pop_size = (
+            0 if self.population is None else int(self.population.registered)
+        )
         fn = self._wrapped_program(
             kind, epochs, n_rounds, w.ndim, donate, tele_on, a_ndim,
             codec, frac, model_axes, mesh_layout, fedbuff, stale_exp,
-            capacity, mesh_nodes,
+            capacity, mesh_nodes, mesh_hosts, pop_size,
         )
         if Settings.TRACE_CONTRACTS:
             # Dispatch-time contract: the fetched program's build-time
@@ -2065,6 +2270,8 @@ class FederationEngine:
                     "SHARD_MODEL": int(model_axes),
                     "SHARD_LAYOUT": str(mesh_layout),
                     "ASYNC_STALENESS_EXP": float(stale_exp),
+                    "SHARD_HOSTS": int(mesh_hosts),
+                    "POPULATION_CLIENTS": int(pop_size),
                 },
             )
 

@@ -42,6 +42,12 @@ NODE_AXIS = "nodes"
 #: Canonical name of the model-parallel axis of the engine's 2D mesh.
 MODEL_AXIS = "model"
 
+#: Canonical name of the cross-host (multi-process / DCN) axis of the
+#: engine's 3D ``hosts x nodes x model`` mesh. Collectives over it ride
+#: DCN, not ICI — the engine folds per-host partial psums over
+#: ``nodes`` first and only the partial aggregate crosses this axis.
+HOST_AXIS = "hosts"
+
 #: Axis-name aliases for standalone FSDP / tensor-parallel meshes
 #: (ShardedTrainer / SpecLayout policies that split the two roles).
 FSDP_AXIS = "fsdp"
@@ -73,14 +79,36 @@ def create_mesh(
     return Mesh(dev_array, tuple(axes.keys()))
 
 
+def node_shard_dims(mesh: Optional[Mesh], axis: str = NODE_AXIS):
+    """The mesh dims the stacked NODE axis shards over: ``(hosts,
+    nodes)`` on a 3D multi-host mesh, ``(nodes,)`` otherwise. The
+    leading stacked dimension always shards over ALL of them — each
+    host's device shard holds a contiguous run of logical nodes."""
+    if mesh is not None and mesh_axis_size(mesh, HOST_AXIS) > 1:
+        return (HOST_AXIS, axis)
+    return (axis,)
+
+
+def node_shard_size(mesh: Optional[Mesh], axis: str = NODE_AXIS) -> int:
+    """Combined size of the node-sharding dims (hosts x nodes on a 3D
+    mesh) — the device multiple stacked node counts pad up to."""
+    size = 1
+    for a in node_shard_dims(mesh, axis):
+        size *= mesh_axis_size(mesh, a)
+    return size
+
+
 def federation_sharding(mesh: Mesh, axis: str = NODE_AXIS) -> NamedSharding:
     """Sharding for node-stacked pytrees: leading axis over the mesh.
 
-    The leading dimension must be a multiple of the mesh's ``axis``
-    size; round indivisible node counts up with
+    The leading dimension must be a multiple of the mesh's combined
+    node-shard size (:func:`node_shard_size` — ``hosts x nodes`` on a
+    3D mesh); round indivisible node counts up with
     :func:`padded_node_count` + :func:`pad_node_axis` first (zero-weight
     pad rows are exact no-ops under the masked-mean fold)."""
-    return NamedSharding(mesh, PartitionSpec(axis))
+    dims = node_shard_dims(mesh, axis)
+    spec = PartitionSpec(dims if len(dims) > 1 else dims[0])
+    return NamedSharding(mesh, spec)
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
@@ -101,8 +129,11 @@ def padded_node_count(
     — the stacked leading dimension that shards evenly. Equals
     ``n_nodes`` when there is no mesh or it already divides. 2D-aware
     by construction: only the named NODE axis' size enters — a
-    ``nodes=4, model=2`` mesh pads to multiples of 4, never 8."""
-    d = mesh_axis_size(mesh, axis)
+    ``nodes=4, model=2`` mesh pads to multiples of 4, never 8. On a 3D
+    multi-host mesh the node axis shards over ``hosts x nodes``
+    combined (:func:`node_shard_size`), so that product is the
+    multiple."""
+    d = node_shard_size(mesh, axis)
     return ((int(n_nodes) + d - 1) // d) * d
 
 
@@ -303,13 +334,16 @@ def stacked_model_shardings(
 ) -> Any:
     """Per-leaf NamedShardings for a NODE-STACKED state tree on a 2D
     mesh: ``P(nodes, *layout dims)`` — the leading node axis shards
-    over ``nodes``, each node's model over ``model`` per the layout."""
+    over ``nodes`` (``(hosts, nodes)`` on a 3D multi-host mesh), each
+    node's model over ``model`` per the layout."""
     axis_size = mesh_axis_size(mesh, layout.model_axis)
+    lead_dims = node_shard_dims(mesh)
+    lead = lead_dims if len(lead_dims) > 1 else lead_dims[0]
 
     def one(path, leaf):
         shape = tuple(np.shape(leaf))[1:]
         dims = layout.leaf_dims(_path_str(path), shape, axis_size)
-        return NamedSharding(mesh, PartitionSpec(NODE_AXIS, *dims))
+        return NamedSharding(mesh, PartitionSpec(lead, *dims))
 
     return jax.tree_util.tree_map_with_path(one, tree)
 

@@ -1,0 +1,270 @@
+"""Cross-device population tier: 1M registered clients, K resident.
+
+The pfl-research / PeerFL shape of federated learning (PAPERS.md):
+a huge census of REGISTERED, mostly-offline leaf clients, of which
+only K ≈ 100 participate in any round. tpfl's engine already has the
+two kernels this needs — :func:`~tpfl.parallel.engine
+.sample_participants` (the seeded per-round cohort draw) and
+:meth:`~tpfl.parallel.engine.FederationEngine.broadcast_params` (stack
+K working rows from the ONE persistent global model) — this module
+adds the bookkeeping around them:
+
+- :class:`ClientPopulation` — the census. Holds ONLY O(active) state:
+  the persistent model lives in the engine (one model, not N), and
+  per-client records exist solely for clients that have actually
+  participated (a dict that grows with touched clients, never with
+  the census). Registering 1M clients costs a handful of ints.
+- **Two-level topology** — the engine's resident nodes are EDGE
+  AGGREGATORS: they gossip P2P over the mesh (the engine's fold — over
+  ``nodes`` on ICI and ``hosts`` on DCN), while sampled leaf clients
+  attach to edges by :meth:`edge_assignment` for the round. A round is
+  therefore leaf→edge intake (the sampled cohort trains as the
+  engine's node rows) + the edges' P2P fold.
+- **Straggler cutoffs** — :meth:`round_weights` zeroes a seeded
+  fraction of the cohort exactly like quorum degradation (a w=0 row
+  is ignored by the masked fold, bit-for-bit), and
+  :meth:`straggler_schedule` lowers the same skew to a
+  :class:`~tpfl.parallel.engine.FedBuffSchedule` so late clients fold
+  staleness-weighted instead of dropping.
+- **Checkpointing** — :meth:`state_export` / :meth:`state_import`
+  round-trip through :class:`~tpfl.management.checkpoint
+  .EngineCheckpointer` via ``FederationEngine.export_state`` (which
+  includes an attached population automatically). The snapshot is
+  O(touched clients): sampled clients' records restore exactly;
+  never-sampled clients have no state to restore.
+
+See docs/scaling.md "Cross-device population tier".
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from tpfl.parallel.engine import FedBuffSchedule, sample_participants
+from tpfl.settings import Settings
+
+__all__ = ["ClientPopulation"]
+
+
+class ClientPopulation:
+    """A registered cross-device census sampling K participants/round.
+
+    ``registered`` / ``sample`` default to
+    ``Settings.POPULATION_CLIENTS`` / ``Settings.POPULATION_SAMPLE``;
+    ``seed`` keys every draw — same census, same seed, same round ⇒
+    the same cohort, byte for byte (the engine's determinism
+    discipline extended over sampling). ``self.round`` is the
+    population's own round cursor, advanced by
+    :meth:`complete_round` and restored by checkpoints.
+    """
+
+    def __init__(
+        self,
+        registered: Optional[int] = None,
+        sample: Optional[int] = None,
+        seed: int = 0,
+    ) -> None:
+        self.registered = int(
+            registered
+            if registered is not None
+            else Settings.POPULATION_CLIENTS
+        )
+        self.sample = int(
+            sample if sample is not None else Settings.POPULATION_SAMPLE
+        )
+        if self.registered <= 0:
+            raise ValueError(
+                f"population needs registered > 0, got {self.registered} "
+                f"(set Settings.POPULATION_CLIENTS or pass registered=)"
+            )
+        if not (0 < self.sample <= self.registered):
+            raise ValueError(
+                f"cannot sample {self.sample} of {self.registered} "
+                f"registered clients"
+            )
+        self.seed = int(seed)
+        self.round = 0
+        # O(touched), never O(registered): a record exists only once a
+        # client has folded. int keys in memory; stringified for the
+        # msgpack checkpoint (state_export).
+        self.clients: dict[int, dict] = {}
+        self._engine: Optional[Any] = None
+
+    # --- engine binding ---------------------------------------------------
+
+    def bind(self, engine: Any) -> None:
+        """Called by ``FederationEngine.attach_population``: remember
+        the engine whose resident nodes serve as this population's
+        edge aggregators. The engine's node axis is the round's
+        working set — it must hold the sampled cohort."""
+        if engine is not None and self.sample > int(engine.n_nodes):
+            raise ValueError(
+                f"sampled cohort of {self.sample} does not fit the "
+                f"engine's {engine.n_nodes} node rows"
+            )
+        self._engine = engine
+
+    # --- the per-round cycle ----------------------------------------------
+
+    def begin_round(self, round: Optional[int] = None) -> np.ndarray:
+        """The round's cohort: ``sample`` distinct client ids drawn
+        from the census, seeded by ``(seed, round)`` — recomputable at
+        any time (resume re-draws the same cohort from the restored
+        round cursor)."""
+        r = self.round if round is None else int(round)
+        return sample_participants(self.registered, self.sample, self.seed, r)
+
+    def edge_assignment(
+        self, ids: Any, n_edges: Optional[int] = None
+    ) -> np.ndarray:
+        """Edge-aggregator index per sampled client — the two-level
+        topology's attach step. Round-robin over the cohort's sorted
+        order: deterministic, and balanced to within one client per
+        edge. ``n_edges`` defaults to the bound engine's logical node
+        count (every resident node serves as an edge)."""
+        if n_edges is None:
+            if self._engine is None:
+                raise ValueError(
+                    "edge_assignment needs n_edges= or a bound engine"
+                )
+            n_edges = int(self._engine.n_nodes)
+        ids = np.asarray(ids)
+        return np.arange(ids.shape[0]) % max(1, int(n_edges))
+
+    def round_weights(
+        self,
+        ids: Any,
+        cutoff_frac: float = 0.0,
+        round: Optional[int] = None,
+    ) -> np.ndarray:
+        """[K] fold weights for the cohort with a seeded
+        ``cutoff_frac`` of stragglers ZEROED — the quorum-degradation
+        reuse: a cut client's row rides the dispatch untouched and the
+        masked fold ignores it exactly, so the straggler cutoff costs
+        no recompile and no shape change. At least one client always
+        survives (an all-zero round would re-enter the uniform
+        fallback with semantics no cross-device tier wants)."""
+        ids = np.asarray(ids)
+        k = int(ids.shape[0])
+        w = np.ones((k,), np.float32)
+        frac = float(cutoff_frac)
+        if frac <= 0.0:
+            return w
+        r = self.round if round is None else int(round)
+        n_cut = min(int(frac * k), k - 1)
+        if n_cut > 0:
+            rng = np.random.default_rng(
+                np.random.SeedSequence([self.seed, r, 1])
+            )
+            w[rng.choice(k, size=n_cut, replace=False)] = 0.0
+        return w
+
+    def straggler_schedule(
+        self,
+        n_rounds: int,
+        straggler_frac: float = 0.25,
+        max_staleness: int = 2,
+        start_round: Optional[int] = None,
+    ) -> FedBuffSchedule:
+        """The FedBuff path for the cohort: a seeded
+        ``straggler_frac`` of the K participants run on longer arrival
+        periods (up to ``max_staleness + 1`` rounds), so their
+        contributions fold late and staleness-weighted instead of
+        dropping — :meth:`FedBuffSchedule.from_periods` over the
+        sampled cohort, with the population's seed/round keying the
+        draw."""
+        r0 = self.round if start_round is None else int(start_round)
+        rng = np.random.default_rng(
+            np.random.SeedSequence([self.seed, r0, 2])
+        )
+        periods = np.ones((self.sample,), np.int64)
+        n_slow = min(int(float(straggler_frac) * self.sample),
+                     self.sample - 1)
+        if n_slow > 0:
+            slow = rng.choice(self.sample, size=n_slow, replace=False)
+            periods[slow] = rng.integers(
+                2, max(2, int(max_staleness) + 1) + 1, size=n_slow
+            )
+        return FedBuffSchedule.from_periods(
+            periods, int(n_rounds), start_round=r0
+        )
+
+    def complete_round(
+        self,
+        ids: Any,
+        weights: Optional[Any] = None,
+        losses: Optional[Any] = None,
+    ) -> None:
+        """Commit one round: advance the round cursor and the folded
+        clients' records (stragglers — w=0 rows — do not advance:
+        their contribution never folded). ``losses`` (optional,
+        positionally aligned with ``ids``) lands in each record as
+        the client's last observed loss."""
+        ids = np.asarray(ids)
+        w = (
+            np.ones((ids.shape[0],), np.float32)
+            if weights is None
+            else np.asarray(weights, np.float32)
+        )
+        for pos, cid in enumerate(ids):
+            if w[pos] <= 0:
+                continue
+            rec = self.clients.setdefault(
+                int(cid), {"rounds": 0, "last_round": -1, "loss": 0.0}
+            )
+            rec["rounds"] = int(rec["rounds"]) + 1
+            rec["last_round"] = int(self.round)
+            if losses is not None:
+                rec["loss"] = float(np.asarray(losses)[pos])
+        self.round += 1
+
+    @property
+    def touched(self) -> int:
+        """Clients that have ever folded — the snapshot's size."""
+        return len(self.clients)
+
+    # --- checkpoint state -------------------------------------------------
+
+    def state_export(self) -> dict:
+        """O(touched) snapshot (msgpack-safe: client ids stringify —
+        flax's serializer requires str keys)."""
+        return {
+            "registered": int(self.registered),
+            "sample": int(self.sample),
+            "seed": int(self.seed),
+            "round": int(self.round),
+            "clients": {
+                str(cid): {
+                    "rounds": int(rec["rounds"]),
+                    "last_round": int(rec["last_round"]),
+                    "loss": float(rec["loss"]),
+                }
+                for cid, rec in self.clients.items()
+            },
+        }
+
+    def state_import(self, state: dict) -> None:
+        self.registered = int(state["registered"])
+        self.sample = int(state["sample"])
+        self.seed = int(state["seed"])
+        self.round = int(state["round"])
+        self.clients = {
+            int(cid): {
+                "rounds": int(rec["rounds"]),
+                "last_round": int(rec["last_round"]),
+                "loss": float(rec["loss"]),
+            }
+            for cid, rec in dict(state.get("clients", {})).items()
+        }
+
+    @classmethod
+    def from_state(cls, state: dict) -> "ClientPopulation":
+        pop = cls(
+            registered=int(state["registered"]),
+            sample=int(state["sample"]),
+            seed=int(state["seed"]),
+        )
+        pop.state_import(state)
+        return pop

@@ -646,6 +646,48 @@ class Settings:
     Resolved at engine construction; a cache-key axis of the engine's
     round programs like the other ENGINE_* knobs."""
 
+    SHARD_HOSTS: int = 1
+    """Cross-host axis size of the engine's auto mesh
+    (``tpfl.parallel.engine.auto_mesh``): 1 (default) = single-process
+    meshes only — engine programs lower byte-identical to the
+    single-host path; 0 = auto: one ``hosts`` slot per participating
+    process (``jax.process_count()`` after
+    ``tpfl.parallel.distributed.ensure_distributed``); H > 1 = a
+    forced ``hosts`` axis of that size (works single-process too, for
+    parity testing — the hosts axis then spans local devices). With
+    hosts > 1 the engine lowers a 3D ``hosts x nodes x model`` mesh
+    whose FedAvg fold decomposes into two psum legs: per-host node
+    shards fold local partials over ``nodes`` (ICI), then the partial
+    aggregates cross ``hosts`` over DCN — with ``ENGINE_WIRE_CODEC``
+    quantizing that DCN leg natively (see docs/scaling.md "3D mesh &
+    cross-host DCN"). A program-cache and ``stamp_contract`` axis like
+    the other SHARD_* knobs. Read at engine construction /
+    auto_mesh."""
+
+    POPULATION_CLIENTS: int = 0
+    """Registered client population of the cross-device tier
+    (tpfl.parallel.population.ClientPopulation): 0 (default) = no
+    population tier — every logical node is resident, the pure P2P
+    layout. N > 0 = N registered, mostly-offline leaf clients attach
+    to the engine's resident nodes (now edge aggregators) by per-round
+    sampling: each round draws ``POPULATION_SAMPLE`` participants via
+    the seeded ``sample_participants`` kernel, broadcasts the current
+    edge model with ``broadcast_params``, and folds only the sampled
+    cohort — so live state stays O(sampled), never O(N). Registered
+    metadata (per-client round counters, last-seen) lives in a NumPy
+    structure-of-arrays costing a few bytes/client. A program-cache
+    and contract axis of the engine's round programs. See
+    docs/scaling.md "Cross-device population tier"."""
+
+    POPULATION_SAMPLE: int = 100
+    """Participants sampled per round from the registered population
+    (the K of K-out-of-N cross-device FL, pfl-research style): only
+    these clients' state is materialized, trained and folded in a
+    round; stragglers beyond the engine's quorum/FedBuff cutoffs are
+    dropped by the same zero-weight masking as resident nodes. Read
+    when a ClientPopulation is built; ignored while
+    POPULATION_CLIENTS is 0."""
+
     SHARD_ROUNDS_PER_DISPATCH: int = 1
     """Federation rounds folded into ONE device dispatch by the
     engine's ``lax.fori_loop`` round window
@@ -951,6 +993,12 @@ class Settings:
         cls.SHARD_DEVICES = 0
         cls.SHARD_MODEL = 1
         cls.SHARD_LAYOUT = "auto"
+        # Single-process meshes and no population tier in tests —
+        # cross-host / cross-device cases force SHARD_HOSTS /
+        # POPULATION_CLIENTS per-case.
+        cls.SHARD_HOSTS = 1
+        cls.POPULATION_CLIENTS = 0
+        cls.POPULATION_SAMPLE = 100
         cls.SHARD_ROUNDS_PER_DISPATCH = 1
         # Engine-plane telemetry off by default (engine_obs tests and
         # the bench engine_obs tier toggle per-case): the elided carry
@@ -1080,6 +1128,11 @@ class Settings:
         cls.SHARD_DEVICES = 0
         cls.SHARD_MODEL = 1
         cls.SHARD_LAYOUT = "auto"
+        # One process, resident nodes only: no cross-host axis, no
+        # cross-device population — the reference P2P layout.
+        cls.SHARD_HOSTS = 1
+        cls.POPULATION_CLIENTS = 0
+        cls.POPULATION_SAMPLE = 100
         cls.SHARD_ROUNDS_PER_DISPATCH = 1
         # Engine telemetry is an opt-in diagnostic here, like tracing/
         # profiling: enable it for engine-window runs you intend to
@@ -1266,6 +1319,17 @@ class Settings:
         # ("auto" = zoo transformer rules, MLP/CNN replicated).
         cls.SHARD_MODEL = 1
         cls.SHARD_LAYOUT = "auto"
+        # Auto cross-host: a process launched under
+        # jax.distributed (tpfl.parallel.distributed) contributes one
+        # hosts-axis slot per participating process; a lone process
+        # resolves to hosts=1 and lowers the single-host programs
+        # unchanged. Population tier stays opt-in even at scale — set
+        # POPULATION_CLIENTS to the registered census to turn the
+        # resident nodes into edge aggregators sampling
+        # POPULATION_SAMPLE leaf clients per round.
+        cls.SHARD_HOSTS = 0
+        cls.POPULATION_CLIENTS = 0
+        cls.POPULATION_SAMPLE = 100
         cls.SHARD_ROUNDS_PER_DISPATCH = 8
         # At scale the engine IS the federation — without the carry an
         # 8-round window is one opaque dispatch none of the planes can
