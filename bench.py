@@ -577,9 +577,10 @@ def _analysis_tier(extra: dict) -> None:
 
     - extra.analysis_static: wall-time of the full tpflcheck suite
       (guards/locks/capture/spmd/sync/layers/knobs/threads/trace/
-      events/donate/wire) over the tree — budget < 5 s, zero unwaived
-      violations, plus per-pass counts for the JAX-semantics passes
-      (capture/spmd/sync must each be clean — CI-gated).
+      events/donate/wire/state/rank) over the tree — budget < 5 s,
+      zero unwaived violations, plus per-pass counts for the
+      JAX-semantics passes (capture/spmd/sync) and the ISSUE-19
+      state/rank passes (each must be clean — CI-gated).
     - extra.analysis_lock_trace: the same seeded 3-node digits
       federation run with Settings.LOCK_TRACING off and then on —
       the traced run must finish with an ACYCLIC runtime acquisition
@@ -597,7 +598,9 @@ def _analysis_tier(extra: dict) -> None:
     try:
         from tools.tpflcheck import (
             check_capture,
+            check_rank,
             check_spmd,
+            check_state,
             check_sync,
             run_all,
         )
@@ -613,6 +616,8 @@ def _analysis_tier(extra: dict) -> None:
             "capture": len(check_capture(root)),
             "spmd": len(check_spmd(root)),
             "sync": len(check_sync(root)),
+            "state": len(check_state(root)),
+            "rank": len(check_rank(root)),
         }
         jax_passes_wall = time.monotonic() - t1
         extra["analysis_static"] = {
@@ -622,6 +627,11 @@ def _analysis_tier(extra: dict) -> None:
             "zero_violations": not violations,
             "jax_pass_violations": per_pass,
             "jax_passes_clean": not any(per_pass.values()),
+            # Per-pass acceptance booleans for the ISSUE-19 passes —
+            # the baseline gate can't anchor a count on a 0 baseline,
+            # so cleanliness gates as a flag like the suite-wide zero.
+            "state_pass_clean": per_pass["state"] == 0,
+            "rank_pass_clean": per_pass["rank"] == 0,
             "jax_passes_wall_s": round(jax_passes_wall, 2),
             "waived": len(waived),
             "warnings": len(warnings),

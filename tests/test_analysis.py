@@ -13,7 +13,11 @@ Three layers of coverage:
    silently stopped finding anything would otherwise look exactly
    like a clean tree. The capture pass additionally PROVES the
    engine's cache-key totality over its four knob axes by deleting
-   each axis from a copy of the real engine source.
+   each axis from a copy of the real engine source; the state pass
+   (ISSUE 19) proves the engine's export totality by deleting an
+   exported field read the same way, and the rank pass proves the
+   crosshost gate discipline by inserting a process_index()-gated
+   dispatch into a copy of the real crosshost source.
 3. The runtime halves: TracedLock cycle detection as a unit test plus
    a chaos-marked e2e federation with ``Settings.LOCK_TRACING = True``
    asserting an acyclic acquisition graph of NAMED threads, and
@@ -40,7 +44,9 @@ from tools.tpflcheck import (  # noqa: E402
     check_knobs,
     check_layers,
     check_locks,
+    check_rank,
     check_spmd,
+    check_state,
     check_sync,
     check_threads,
     check_trace,
@@ -1142,3 +1148,272 @@ def test_lock_traced_federation_acyclic_and_named(_traced_locks):
         or t == "MainThread"
         for t in names
     ), names
+
+
+# --- state: checkpoint-state totality (ISSUE 19) --------------------------
+
+
+STATE_BAD = """\
+    class MembershipView:
+        def __init__(self):
+            self._slots = {}
+            self._epoch = 0
+
+        def join(self, node):
+            self._slots[node] = True
+            self._epoch += 1
+
+        def state_export(self):
+            return {"slots": dict(self._slots)}
+
+        def state_import(self, state):
+            self._slots = dict(state["slots"])
+"""
+
+STATE_GOOD = STATE_BAD.replace(
+    '            return {"slots": dict(self._slots)}',
+    '            return {"slots": dict(self._slots),\n'
+    '                    "epoch": int(self._epoch)}',
+).replace(
+    '            self._slots = dict(state["slots"])',
+    '            self._slots = dict(state["slots"])\n'
+    '            self._epoch = int(state.get("epoch", 0))',
+)
+
+
+def test_state_fixture_unexported_field(tmp_path):
+    # membership.py is on the state pass's checkpointed roster.
+    root = _mini_repo(tmp_path, {"tpfl/parallel/membership.py": STATE_BAD})
+    found = check_state(root)
+    assert any(
+        v.key == "state:tpfl/parallel/membership.py::MembershipView._epoch"
+        for v in found
+    ), [v.render() for v in found]
+    root2 = _mini_repo(tmp_path / "ok", {"tpfl/parallel/membership.py": STATE_GOOD})
+    assert check_state(root2) == [], [v.render() for v in check_state(root2)]
+
+
+def test_state_fixture_ephemeral_escape(tmp_path):
+    annotated = STATE_BAD.replace(
+        "            self._epoch = 0",
+        "            # ephemeral: monotonic join counter, only used for\n"
+        "            # live tier-promotion pacing — a resumed view restarts it\n"
+        "            self._epoch = 0",
+    )
+    root = _mini_repo(tmp_path, {"tpfl/parallel/membership.py": annotated})
+    assert check_state(root) == [], [v.render() for v in check_state(root)]
+    # The reason is MANDATORY: a bare '# ephemeral:' is itself a finding.
+    bare = STATE_BAD.replace(
+        "            self._epoch = 0",
+        "            # ephemeral:\n            self._epoch = 0",
+    )
+    root2 = _mini_repo(tmp_path / "bare", {"tpfl/parallel/membership.py": bare})
+    found = check_state(root2)
+    assert any(v.key.endswith("._epoch::reason") for v in found), [
+        v.render() for v in found
+    ]
+
+
+def test_state_fixture_key_asymmetry(tmp_path):
+    src = """\
+        class MembershipView:
+            def __init__(self):
+                self._slots = {}
+
+            def join(self, node):
+                self._slots[node] = True
+
+            def state_export(self):
+                return {"slots": dict(self._slots), "extra": 1}
+
+            def state_import(self, state):
+                self._slots = dict(state["slots"])
+                ghost = state.get("ghost", None)
+    """
+    root = _mini_repo(tmp_path, {"tpfl/parallel/membership.py": src})
+    keys = {v.key for v in check_state(root)}
+    assert (
+        "state:tpfl/parallel/membership.py::MembershipView[extra]:export-only"
+        in keys
+    ), keys
+    assert (
+        "state:tpfl/parallel/membership.py::MembershipView[ghost]:import-only"
+        in keys
+    ), keys
+
+
+def test_state_fixture_one_hop_export(tmp_path):
+    # The export delegates to a same-class helper; the helper's reads
+    # and written keys count (one call level deep), so this is clean.
+    src = """\
+        class MembershipView:
+            def __init__(self):
+                self._slots = {}
+                self._epoch = 0
+
+            def join(self, node):
+                self._slots[node] = True
+                self._epoch += 1
+
+            def _fill(self, out):
+                out["slots"] = dict(self._slots)
+                out["epoch"] = int(self._epoch)
+
+            def state_export(self):
+                out = {}
+                self._fill(out)
+                return out
+
+            def state_import(self, state):
+                self._restore(state)
+
+            def _restore(self, state):
+                self._slots = dict(state["slots"])
+                self._epoch = int(state["epoch"])
+    """
+    root = _mini_repo(tmp_path, {"tpfl/parallel/membership.py": src})
+    assert check_state(root) == [], [v.render() for v in check_state(root)]
+
+
+def test_state_proves_engine_export_totality(tmp_path):
+    """Acceptance: deleting an exported field read from a copy of the
+    real engine source fails the state pass naming the field (and the
+    orphaned import side of the key)."""
+    src = (REPO / "tpfl" / "parallel" / "engine.py").read_text()
+    target = tmp_path / "tpfl" / "parallel" / "engine.py"
+    target.parent.mkdir(parents=True)
+    target.write_text(src)
+    assert check_state(tmp_path) == [], [
+        v.render() for v in check_state(tmp_path)
+    ]  # the real engine is clean
+    frag = '"rounds_done": int(self._rounds_done),'
+    assert frag in src
+    target.write_text(src.replace(frag, "", 1))
+    keys = {v.key for v in check_state(tmp_path)}
+    assert (
+        "state:tpfl/parallel/engine.py::FederationEngine._rounds_done" in keys
+    ), keys  # the lost field, by name
+    assert (
+        "state:tpfl/parallel/engine.py::FederationEngine[rounds_done]:import-only"
+        in keys
+    ), keys  # and the now-orphaned import key
+
+
+# --- rank: multi-host divergence lint (ISSUE 19) --------------------------
+
+
+RANK_BAD = """\
+    import jax
+
+
+    def drive(eng, params, xs, ys):
+        if jax.process_index() == 0:
+            eng.run_rounds(params, xs, ys, n_rounds=1)
+"""
+
+RANK_GOOD = RANK_BAD.replace(
+    "        if jax.process_index() == 0:",
+    "        # rank-dependent: rank-local mesh=None probe, no collectives\n"
+    "        if jax.process_index() == 0:",
+)
+
+
+def test_rank_fixture_gated_dispatch(tmp_path):
+    # crosshost.py is on the rank pass's roster.
+    root = _mini_repo(tmp_path, {"tpfl/parallel/crosshost.py": RANK_BAD})
+    found = check_rank(root)
+    assert any(
+        v.check == "rank" and "run_rounds" in v.message for v in found
+    ), [v.render() for v in found]
+    root2 = _mini_repo(tmp_path / "ok", {"tpfl/parallel/crosshost.py": RANK_GOOD})
+    assert check_rank(root2) == [], [v.render() for v in check_rank(root2)]
+
+
+def test_rank_fixture_derived_value_and_else_arm(tmp_path):
+    # The taint flows through an assignment, and the ELSE arm is just
+    # as rank-gated as the body (it runs on the ranks the if skipped).
+    src = """\
+        import jax
+
+
+        def drive(eng, params, xs, ys):
+            lead = jax.process_index() == 0
+            if lead:
+                pass
+            else:
+                eng.dispatch_window(params, xs, ys)
+    """
+    root = _mini_repo(tmp_path, {"tpfl/parallel/crosshost.py": src})
+    found = check_rank(root)
+    assert any("dispatch_window" in v.message for v in found), [
+        v.render() for v in found
+    ]
+
+
+def test_rank_fixture_one_hop_resolution(tmp_path):
+    # is_lead() derives from process_index in its body; a dispatch
+    # gated on its RESULT is caught through the one-hop index.
+    src = """\
+        import jax
+
+
+        def is_lead():
+            return jax.process_index() == 0
+
+
+        def drive(eng, params, xs, ys):
+            if is_lead():
+                eng.run_rounds(params, xs, ys, n_rounds=1)
+    """
+    root = _mini_repo(tmp_path, {"tpfl/parallel/crosshost.py": src})
+    found = check_rank(root)
+    assert any("run_rounds" in v.message for v in found), [
+        v.render() for v in found
+    ]
+
+
+def test_rank_fixture_shortcircuit_and_ternary(tmp_path):
+    src = """\
+        import jax
+        from jax import lax
+
+
+        def drive(eng, params, xs, ys, x):
+            jax.process_index() == 0 and eng.run_rounds(params, xs, ys)
+            y = lax.psum(x, "nodes") if jax.process_index() else x
+    """
+    root = _mini_repo(tmp_path, {"tpfl/parallel/crosshost.py": src})
+    found = check_rank(root)
+    assert any("run_rounds" in v.message for v in found), [
+        v.render() for v in found
+    ]
+    assert any("psum" in v.message for v in found), [
+        v.render() for v in found
+    ]
+
+
+def test_rank_proves_crosshost_gate(tmp_path):
+    """Acceptance: inserting a process_index()-gated run_rounds into a
+    copy of the real crosshost source fails the rank pass naming the
+    inserted line."""
+    src = (REPO / "tpfl" / "parallel" / "crosshost.py").read_text()
+    target = tmp_path / "tpfl" / "parallel" / "crosshost.py"
+    target.parent.mkdir(parents=True)
+    target.write_text(src)
+    assert check_rank(tmp_path) == [], [
+        v.render() for v in check_rank(tmp_path)
+    ]  # the real module is clean (the fork harness is annotated)
+    inserted = (
+        "\n\ndef _leaked_gate(eng, params, xs, ys):\n"
+        "    if jax.process_index() == 0:\n"
+        "        eng.run_rounds(params, xs, ys, n_rounds=1)\n"
+    )
+    target.write_text(src + inserted)
+    dispatch_line = (src + inserted).splitlines().index(
+        "        eng.run_rounds(params, xs, ys, n_rounds=1)"
+    ) + 1
+    found = check_rank(tmp_path)
+    assert any(
+        v.key == f"rank:tpfl/parallel/crosshost.py:{dispatch_line}"
+        for v in found
+    ), (dispatch_line, [v.render() for v in found])

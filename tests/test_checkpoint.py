@@ -322,3 +322,113 @@ def test_engine_state_carries_controller_and_quarantine():
     assert q2.quarantined() == {"peerX"}
     # The verdict cache's (round, verdict) tuples are rebuilt.
     assert q2.state_export()["last"]["peerX"] == [2, {"exclude": True}]
+
+
+# --- STATE_CONTRACTS: the state pass's runtime half (ISSUE 19) ------------
+
+
+def test_shadow_verify_names_missing_field():
+    """Direct unit: a payload whose restore drops a key raises
+    StateContractError carrying the field by name."""
+    from flax import serialization as flax_ser
+
+    from tpfl.management.checkpoint import StateContractError, _shadow_verify
+
+    state = {
+        "params": {"w": np.zeros((2, 3), np.float32)},
+        "rounds_done": 7,
+        "seed": 3,
+    }
+    good = flax_ser.msgpack_serialize(state)
+    _shadow_verify(state, good)  # faithful payload passes
+    doctored = flax_ser.msgpack_serialize(
+        {k: v for k, v in state.items() if k != "seed"}
+    )
+    with pytest.raises(StateContractError, match="'seed'"):
+        _shadow_verify(state, doctored)
+    # A corrupted VALUE (same key set) is a digest mismatch.
+    corrupt = flax_ser.msgpack_serialize({**state, "rounds_done": 8})
+    with pytest.raises(StateContractError, match="'rounds_done'"):
+        _shadow_verify(state, corrupt)
+
+
+def test_state_contracts_save_blocks_publication(tmp_path, monkeypatch):
+    """A snapshot that cannot faithfully restore never becomes LATEST:
+    the prior good checkpoint stays published."""
+    import flax.serialization as flax_ser
+
+    from tpfl.management.checkpoint import StateContractError
+    from tpfl.settings import Settings
+
+    Settings.STATE_CONTRACTS = True
+    ck = EngineCheckpointer(str(tmp_path), node="sc")
+    ck.save({"params": {}, "rounds_done": 1, "seed": 0}, step=1)
+    assert ck.latest_step() == 1
+
+    real_restore = flax_ser.msgpack_restore
+
+    def lossy_restore(payload):
+        out = real_restore(payload)
+        out.pop("seed", None)  # simulate a key the round-trip loses
+        return out
+
+    monkeypatch.setattr(flax_ser, "msgpack_restore", lossy_restore)
+    with pytest.raises(StateContractError, match="'seed'"):
+        ck.save({"params": {}, "rounds_done": 2, "seed": 0}, step=2)
+    monkeypatch.setattr(flax_ser, "msgpack_restore", real_restore)
+    restored, meta = ck.restore()
+    assert meta["step"] == 1 and restored["rounds_done"] == 1
+
+
+def test_state_contracts_kill_and_resume_full_attach(tmp_path):
+    """Acceptance: with STATE_CONTRACTS on (the test profile default),
+    a kill-and-resume through EngineCheckpointer round-trips an engine
+    with controller + membership + population + quarantine attached."""
+    from tpfl.learning.async_control import AsyncController
+    from tpfl.management.quarantine import QuarantineEngine
+    from tpfl.parallel.membership import MembershipView
+    from tpfl.parallel.population import ClientPopulation
+    from tpfl.settings import Settings
+
+    assert Settings.STATE_CONTRACTS  # set_test_settings arms it
+    n = 2
+    xs, ys = _node_data(n)
+    fed = _fed(n)
+    eng = fed.engine
+    eng.controller = AsyncController("nodeA")
+    eng.controller.state_import(
+        {"ia_q": 0.25, "tau_mean": 1.25, "k": 3, "deadline": 2.0,
+         "trajectory": [{"round": 0, "k": 3, "deadline": 2.0}]}
+    )
+    eng.attach_membership(MembershipView([f"n{i}" for i in range(n)]))
+    eng.attach_population(ClientPopulation(registered=64, sample=2, seed=3))
+    eng.population.begin_round()
+    q = QuarantineEngine("nodeA")
+    q.state_import(
+        {"state": {"peerX": {"active": True, "since_round": 1,
+                             "last_flag_round": 2, "reasons": ["norm"],
+                             "readmissions": 0}},
+         "actions": [], "last": {}}
+    )
+    params = fed.run_rounds(fed.init_params((28, 28)), xs, ys, n_rounds=1)[0]
+
+    ck = EngineCheckpointer(str(tmp_path), node="resume")
+    ck.save(eng.export_state(params, quarantine=q), step=1)
+
+    # The "killed" process: a fresh federation restores the snapshot.
+    state, _meta = ck.restore()
+    fed2 = _fed(n, seed=9)
+    eng2 = fed2.engine
+    eng2.controller = AsyncController("nodeB")
+    eng2.attach_membership(MembershipView())
+    eng2.attach_population(ClientPopulation(registered=64, sample=2, seed=99))
+    q2 = QuarantineEngine("nodeB")
+    out = eng2.import_state(state, quarantine=q2)
+    assert _params_equal(state["params"], eng2.unpad(out["params"]))
+    assert eng2.seed == eng.seed  # the checkpointed seed wins
+    assert eng2.controller.state_export()["k"] == eng.controller.state_export()["k"]
+    assert eng2.membership.state_export() == eng.membership.state_export()
+    assert eng2.population.state_export() == eng.population.state_export()
+    assert q2.quarantined() == {"peerX"}
+    # And the resumed engine can keep training.
+    fed2.run_rounds(out["params"], xs, ys, n_rounds=1)

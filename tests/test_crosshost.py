@@ -236,3 +236,47 @@ def test_two_process_gloo_matches_single_process():
     np.testing.assert_allclose(
         np.array(res[0]["losses"]), np.array(ref["losses"]), atol=1e-5
     )
+
+
+# --- (e) RANK_CONTRACTS: the rank pass's runtime half (ISSUE 19) -----------
+
+
+def test_rank_contracts_receipts_match_across_ranks():
+    """With RANK_CONTRACTS armed, every worker stamps its receipt with
+    the ordered (cache key, lowered-HLO fingerprint) digests of its
+    dispatches; launch() compares them across ranks — a healthy world
+    has byte-identical sequences, so the launch succeeds and the
+    receipts agree entry for entry."""
+    res = launch(
+        num_processes=2,
+        devices_per_proc=4,
+        rounds=2,
+        knobs={"SHARD_NODES": True, "SHARD_HOSTS": 0,
+               "ENGINE_TELEMETRY": False, "RANK_CONTRACTS": True},
+    )
+    receipts = [r["program_digests"] for r in res]
+    assert all(receipts), "armed workers must record dispatches"
+    assert receipts[0] == receipts[1]
+    # Ordinals are the dispatch order; digests carry key + HLO.
+    assert [e["ordinal"] for e in receipts[0]] == list(range(len(receipts[0])))
+    assert all(e["digest"] for e in receipts[0])
+
+
+def test_rank_contracts_forked_run_fails_with_witness():
+    """Acceptance: a deliberately forked run — rank 1 dispatches one
+    extra (rank-local) program — fails the launch with the first
+    divergent (rank, ordinal, key) witness instead of a silent hang."""
+    from tpfl.parallel.ranksafe import RankContractError
+
+    with pytest.raises(
+        RankContractError,
+        match=r"rank 1 diverged from rank 0 at dispatch ordinal",
+    ):
+        launch(
+            num_processes=2,
+            devices_per_proc=4,
+            rounds=1,
+            knobs={"SHARD_NODES": True, "SHARD_HOSTS": 0,
+                   "ENGINE_TELEMETRY": False, "RANK_CONTRACTS": True},
+            fork_rank=1,
+        )

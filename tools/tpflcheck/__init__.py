@@ -1,8 +1,7 @@
 """tpflcheck — tpfl's static concurrency & invariant analysis suite.
 
-Grown out of ``tools/wirecheck.py`` (now retired): one framework,
-shared file-walking / waiver / reporting machinery (``core.py``),
-twelve checks::
+One framework: shared file-walking / waiver / reporting machinery
+(``core.py``), fourteen checks::
 
     guards    guarded-by race lint (# guarded-by: annotations)
     locks     static lock-order extraction + deadlock (cycle) detection
@@ -32,6 +31,14 @@ twelve checks::
               taxonomy tables — waivable)
     wire      codec-registry, copy-discipline and RPC-path lints
               (the original wirecheck trio)
+    state     checkpoint-state totality (every mutable field of the
+              export_state/state_export roster is exported or
+              '# ephemeral:'-annotated; export/import key-set
+              symmetry; runtime half: Settings.STATE_CONTRACTS)
+    rank      multi-host divergence lint (no compiled-program dispatch
+              or collective gated on jax.process_index/process_count-
+              derived values unless '# rank-dependent:'-annotated;
+              runtime half: Settings.RANK_CONTRACTS dispatch receipts)
 
 Run: ``python -m tools.tpflcheck`` (exit 1 on any unwaived violation).
 Waivers are data in ``pyproject.toml`` (``[tool.tpflcheck]``), each
@@ -59,7 +66,9 @@ from tools.tpflcheck.guards import check_guards
 from tools.tpflcheck.knobs import check_knobs
 from tools.tpflcheck.layers import check_layers
 from tools.tpflcheck.locks import check_locks, lock_edges
+from tools.tpflcheck.rank import check_rank
 from tools.tpflcheck.spmd import check_spmd
+from tools.tpflcheck.state import check_state
 from tools.tpflcheck.sync import check_sync
 from tools.tpflcheck.threads import check_threads
 from tools.tpflcheck.trace import check_trace
@@ -74,7 +83,9 @@ __all__ = [
     "check_knobs",
     "check_layers",
     "check_locks",
+    "check_rank",
     "check_spmd",
+    "check_state",
     "check_sync",
     "check_threads",
     "check_trace",
@@ -104,6 +115,8 @@ def run_all(
     violations += check_spmd(root)
     violations += check_sync(root)
     violations += wire.violations(root)
+    violations += check_state(root)
+    violations += check_rank(root)
 
     waivers = load_waivers(root)
     kept, waived = apply_waivers(violations, waivers)

@@ -61,6 +61,7 @@ import ast
 import pathlib
 import re
 
+from tools.tpflcheck import core
 from tools.tpflcheck.core import Violation, py_files, rel, repo_root
 
 _BUILDER_RE = re.compile(r"^_?(?:build|make)_")
@@ -567,8 +568,8 @@ def check_capture(repo: "pathlib.Path | None" = None) -> list[Violation]:
     for path in py_files(root):
         r = rel(root, path)
         try:
-            src = path.read_text(encoding="utf-8")
-            tree = ast.parse(src)
+            src = core.source(path)
+            tree = core.parse(path)
         except SyntaxError:
             continue
         lines = src.splitlines()

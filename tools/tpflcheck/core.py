@@ -19,6 +19,7 @@ docstring already states "caller holds the lock".
 
 from __future__ import annotations
 
+import ast
 import pathlib
 import re
 from dataclasses import dataclass, field
@@ -56,6 +57,47 @@ def py_files(
 
 def rel(root: pathlib.Path, path: pathlib.Path) -> str:
     return path.relative_to(root).as_posix()
+
+
+# --- shared source / AST cache --------------------------------------------
+#
+# Fourteen passes walk the same ~hundred files; parsing dominates the
+# suite's wall time, and re-parsing per pass multiplies it fourteen-
+# fold. Both caches key on (path, mtime_ns, size) so a rewritten file
+# (the fixture-repo tests edit files in place) re-parses, while the
+# unchanged tree is shared across every pass in the process. Passes
+# must treat cached trees as READ-ONLY — none attaches attributes to
+# AST nodes today; keep it that way.
+
+_SRC_CACHE: "dict[tuple[str, int, int], str]" = {}
+_AST_CACHE: "dict[tuple[str, int, int], ast.Module]" = {}
+
+
+def _cache_key(path: pathlib.Path) -> "tuple[str, int, int]":
+    st = path.stat()
+    return (str(path), st.st_mtime_ns, st.st_size)
+
+
+def source(path: pathlib.Path) -> str:
+    """``path.read_text()`` through the shared per-process cache."""
+    key = _cache_key(path)
+    src = _SRC_CACHE.get(key)
+    if src is None:
+        src = path.read_text(encoding="utf-8")
+        _SRC_CACHE[key] = src
+    return src
+
+
+def parse(path: pathlib.Path) -> ast.Module:
+    """``ast.parse`` of ``path`` through the shared per-process cache.
+    Raises ``SyntaxError`` like ``ast.parse`` — callers that tolerate
+    unparsable files keep their own try/except."""
+    key = _cache_key(path)
+    tree = _AST_CACHE.get(key)
+    if tree is None:
+        tree = ast.parse(source(path))
+        _AST_CACHE[key] = tree
+    return tree
 
 
 # --- waivers --------------------------------------------------------------

@@ -36,6 +36,7 @@ import ast
 import pathlib
 from typing import Optional
 
+from tools.tpflcheck import core
 from tools.tpflcheck.core import Violation, py_files, rel, repo_root
 
 
@@ -137,7 +138,7 @@ def check_donate(repo: "pathlib.Path | None" = None) -> list[Violation]:
     violations: list[Violation] = []
     for path in py_files(root, "tpfl"):
         try:
-            tree = ast.parse(path.read_text(encoding="utf-8"))
+            tree = core.parse(path)
         except SyntaxError:
             continue
         donating = _collect_donating(tree)

@@ -30,6 +30,7 @@ import ast
 import pathlib
 import re
 
+from tools.tpflcheck import core
 from tools.tpflcheck.core import Violation, py_files, rel, repo_root
 
 DOC = "docs/observability.md"
@@ -67,7 +68,7 @@ def _emitted_names(
     out: list[tuple[str, str, int]] = []
     for path in py_files(root):
         r = rel(root, path)
-        tree = ast.parse(path.read_text(encoding="utf-8"))
+        tree = core.parse(path)
         for node in ast.walk(tree):
             if not isinstance(node, ast.Call):
                 continue

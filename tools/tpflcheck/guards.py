@@ -51,6 +51,7 @@ import pathlib
 import re
 from dataclasses import dataclass
 
+from tools.tpflcheck import core
 from tools.tpflcheck.core import Violation, py_files, rel, repo_root
 
 #: The modules whose classes own cross-thread mutable state.
@@ -153,9 +154,9 @@ def collect_decls(
         path = root / module
         if not path.exists():
             continue
-        src = path.read_text(encoding="utf-8")
+        src = core.source(path)
         lines = src.splitlines()
-        tree = ast.parse(src)
+        tree = core.parse(path)
         for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
             init = next(
                 (
@@ -326,6 +327,6 @@ def check_guards(repo: "pathlib.Path | None" = None) -> list[Violation]:
             guarded.setdefault(d.attr, []).append(d)
     for path in py_files(root):
         r = rel(root, path)
-        tree = ast.parse(path.read_text(encoding="utf-8"))
+        tree = core.parse(path)
         _AccessChecker(r, guarded, violations).visit(tree)
     return violations

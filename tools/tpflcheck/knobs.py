@@ -29,6 +29,7 @@ from __future__ import annotations
 import ast
 import pathlib
 
+from tools.tpflcheck import core
 from tools.tpflcheck.core import Violation, py_files, rel, repo_root
 
 PROFILE_METHODS = (
@@ -41,7 +42,7 @@ PROFILE_METHODS = (
 def _settings_decl(root: pathlib.Path) -> "tuple[set[str], dict[str, set[str]]]":
     """(declared knobs, profile method -> assigned knobs)."""
     path = root / "tpfl" / "settings.py"
-    tree = ast.parse(path.read_text(encoding="utf-8"))
+    tree = core.parse(path)
     settings_cls = next(
         n
         for n in tree.body
@@ -93,7 +94,7 @@ def _referenced_knobs(root: pathlib.Path) -> dict[str, list[tuple[str, int]]]:
         r = rel(root, path)
         if r == "tpfl/settings.py":
             continue
-        tree = ast.parse(path.read_text(encoding="utf-8"))
+        tree = core.parse(path)
         for node in ast.walk(tree):
             if (
                 isinstance(node, ast.Attribute)

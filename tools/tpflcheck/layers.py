@@ -24,6 +24,7 @@ from __future__ import annotations
 import ast
 import pathlib
 
+from tools.tpflcheck import core
 from tools.tpflcheck.core import Violation, py_files, rel, repo_root
 
 #: Component -> layer number. A component is the first path element
@@ -121,7 +122,7 @@ def check_layers(repo: "pathlib.Path | None" = None) -> list[Violation]:
             )
             continue
         my_layer = LAYERS[comp]
-        tree = ast.parse(path.read_text(encoding="utf-8"))
+        tree = core.parse(path)
         for module, lineno in _module_level_imports(tree):
             target = _component(module)
             if target is None:

@@ -36,6 +36,7 @@ import ast
 import pathlib
 from dataclasses import dataclass, field
 
+from tools.tpflcheck import core
 from tools.tpflcheck.core import Violation, py_files, rel, repo_root
 
 
@@ -81,7 +82,7 @@ class _ModuleIndex:
     def build(self, root: pathlib.Path) -> None:
         for path in py_files(root):
             r = rel(root, path)
-            tree = ast.parse(path.read_text(encoding="utf-8"))
+            tree = core.parse(path)
             for node in tree.body:
                 if isinstance(node, ast.ClassDef):
                     self.known_classes.add(node.name)
@@ -293,7 +294,7 @@ def lock_edges(repo: "pathlib.Path | None" = None) -> list[Edge]:
     edges: list[Edge] = []
     for path in py_files(root):
         r = rel(root, path)
-        tree = ast.parse(path.read_text(encoding="utf-8"))
+        tree = core.parse(path)
         scope = _Scope(r, path.stem)
         _EdgeCollector(scope, index, edges, summaries).visit(tree)
     return edges

@@ -1,0 +1,299 @@
+"""Multi-host divergence lint: rank-gated program dispatch.
+
+On a multi-process (3D ``hosts``-axis) mesh, every process must issue
+the IDENTICAL sequence of compiled SPMD programs and collectives —
+GSPMD's contract. A branch on ``jax.process_index()`` (or anything
+derived from it) around a dispatch means rank 0 enters a collective
+rank 1 never reaches: the fleet hangs on DCN with no error, the single
+worst failure mode the cross-host engine has. ``process_count()`` is
+uniform in a healthy world but joins the taint set anyway — a value
+derived from either marks host-identity-dependent control flow, and
+review must see every place it gates device work.
+
+The pass walks the crosshost roster (:data:`ROSTER`) and flags any
+statement that **dispatches a compiled program or issues a collective**
+while lexically gated by a rank-derived condition (``if`` / ``while``
+/ ternary / ``and``-``or`` short-circuit). "Rank-derived" propagates
+through assignments within a function and ONE level of call
+resolution (like ``locks.py``): a call to a roster function whose body
+reads ``process_index``/``process_count`` (``is_multiprocess``,
+``ensure_distributed``, ``resolve_shard_hosts``) taints its result.
+"Dispatches" is the program-handle naming convention the sync pass
+enforces (``fn`` / ``*_fn`` / ``*_program`` / ``run_rounds`` /
+``evaluate`` / ``dispatch_window``), the named collectives
+(``psum`` / ``all_gather`` / ...), and — one hop deep — any roster
+function whose body contains one.
+
+Escape: ``# rank-dependent: <reason>`` on the dispatch line (or the
+contiguous comment block above it, or on the gating ``if`` itself) —
+for deliberately rank-local work (receipt writing, host-local logging,
+the crosshost fork harness) with the reason as reviewable data.
+
+Runtime half: ``Settings.RANK_CONTRACTS``
+(:mod:`tpfl.parallel.ranksafe`) — every engine dispatch appends the
+digest of its program cache key + lowered-HLO fingerprint to an
+ordered per-process log; ``crosshost.launch`` compares the receipts
+across ranks and fails with the first divergent (rank, ordinal, key)
+witness. The static pass proves gate discipline at review time; the
+receipts catch what it cannot (data-dependent divergence through
+dynamic dispatch).
+
+Waiver keys: ``rank:<file>:<line>``.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+
+from tools.tpflcheck import core
+from tools.tpflcheck.core import Violation, repo_root
+
+#: The crosshost roster: every module that builds or drives the
+#: multi-process engine path.
+ROSTER = (
+    "tpfl/parallel/engine.py",
+    "tpfl/parallel/distributed.py",
+    "tpfl/parallel/crosshost.py",
+    "tpfl/parallel/window_pipeline.py",
+    "tpfl/parallel/population.py",
+)
+
+_RANK_SOURCES = {"process_index", "process_count"}
+
+#: Compiled-program handle names (the sync pass's convention) plus the
+#: window dispatch entry points.
+_DISPATCH_RE = re.compile(
+    r"(^fn$|_fn$|_program$|^run_rounds$|^evaluate$|^dispatch_window$)"
+)
+_COLLECTIVES = {
+    "psum", "psum_scatter", "all_gather", "all_to_all", "pmean",
+    "pmax", "pmin", "ppermute",
+}
+
+_ANNOT_RE = re.compile(r"#\s*rank-dependent:\s*(\S.*)$")
+
+
+def _annotated(lines: "list[str]", lineno: int) -> bool:
+    candidates = [lines[lineno - 1]]
+    i = lineno - 2
+    while i >= 0 and lines[i].strip().startswith("#"):
+        candidates.append(lines[i])
+        i -= 1
+    return any(_ANNOT_RE.search(text) for text in candidates)
+
+
+def _terminal(call: ast.Call) -> "str | None":
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+class _Index:
+    """One-hop call-resolution summaries over every roster module: for
+    each function/method, does it derive a value from ``process_*``,
+    and does its body dispatch a program or collective?"""
+
+    def __init__(self) -> None:
+        self.rank_derived: set[str] = set()
+        self.dispatches: set[str] = set()
+
+    @classmethod
+    def build(cls, trees: "list[ast.Module]") -> "_Index":
+        idx = cls()
+        for tree in trees:
+            for node in ast.walk(tree):
+                if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                reads_rank = False
+                dispatches = False
+                returns = False
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call):
+                        term = _terminal(sub)
+                        if term in _RANK_SOURCES:
+                            reads_rank = True
+                        elif term is not None and (
+                            _DISPATCH_RE.search(term) or term in _COLLECTIVES
+                        ):
+                            dispatches = True
+                    elif isinstance(sub, ast.Return) and sub.value is not None:
+                        returns = True
+                if reads_rank and returns:
+                    idx.rank_derived.add(node.name)
+                if dispatches:
+                    idx.dispatches.add(node.name)
+        return idx
+
+
+class _FnChecker:
+    def __init__(
+        self, relpath: str, fn: ast.AST, lines: "list[str]", index: _Index
+    ) -> None:
+        self.r = relpath
+        self.fn = fn
+        self.lines = lines
+        self.index = index
+        self.tracked: set[str] = set()
+        self.violations: list[Violation] = []
+        self._gates = 0  # rank-derived gate nesting depth
+        self._gate_exempt = 0  # gates carrying their own annotation
+
+    # --- taint ---
+
+    def _rank_expr(self, expr: ast.AST) -> bool:
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Name) and sub.id in self.tracked:
+                return True
+            if isinstance(sub, ast.Call):
+                term = _terminal(sub)
+                if term in _RANK_SOURCES or term in self.index.rank_derived:
+                    return True
+        return False
+
+    def _track_assign(self, node: ast.Assign) -> None:
+        tainted = self._rank_expr(node.value)
+        targets: list[str] = []
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                targets.append(t.id)
+            elif isinstance(t, ast.Tuple):
+                targets.extend(
+                    e.id for e in t.elts if isinstance(e, ast.Name)
+                )
+        for name in targets:
+            (self.tracked.add if tainted else self.tracked.discard)(name)
+
+    # --- dispatch detection ---
+
+    def _is_dispatch(self, call: ast.Call) -> "str | None":
+        term = _terminal(call)
+        if term is None:
+            return None
+        if _DISPATCH_RE.search(term) or term in _COLLECTIVES:
+            return term
+        # One hop: a bare or self.<method> call to a roster function
+        # whose own body dispatches.
+        if term in self.index.dispatches:
+            return term
+        return None
+
+    def _flag_dispatches(self, node: ast.AST) -> None:
+        """Flag every dispatch call lexically under ``node``."""
+        for sub in ast.walk(node):
+            if isinstance(
+                sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            if not isinstance(sub, ast.Call):
+                continue
+            name = self._is_dispatch(sub)
+            if name is None:
+                continue
+            if self._gate_exempt > 0 or _annotated(self.lines, sub.lineno):
+                continue
+            self.violations.append(
+                Violation(
+                    "rank", self.r, sub.lineno,
+                    f"dispatch of {name!r} is gated by a rank-derived "
+                    "condition (jax.process_index/process_count) — every "
+                    "process must issue the identical program sequence "
+                    "or the fleet hangs on the first collective; lift "
+                    "the dispatch out of the branch or annotate "
+                    "'# rank-dependent: <reason>'",
+                    f"rank:{self.r}:{sub.lineno}",
+                )
+            )
+
+    # --- walk ---
+
+    def run(self) -> None:
+        for stmt in ast.iter_child_nodes(self.fn):
+            self._stmt(stmt)
+
+    def _stmt(self, node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return  # nested scope: its own checker run covers it
+        if isinstance(node, (ast.If, ast.While)):
+            self._expr(node.test)
+            gated = self._rank_expr(node.test)
+            exempt = gated and _annotated(self.lines, node.lineno)
+            if gated:
+                self._gates += 1
+                if exempt:
+                    self._gate_exempt += 1
+            # BOTH branches run rank-dependently once the test is
+            # rank-derived — the else arm is the ranks the if skipped.
+            for sub in node.body:
+                self._stmt(sub)
+            for sub in node.orelse:
+                self._stmt(sub)
+            if gated:
+                self._gates -= 1
+                if exempt:
+                    self._gate_exempt -= 1
+            return
+        if isinstance(node, ast.Assign):
+            self._expr(node.value)
+            self._track_assign(node)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._expr(child)
+            else:
+                self._stmt(child)
+
+    def _expr(self, node: ast.AST) -> None:
+        if self._gates > 0:
+            self._flag_dispatches(node)
+        for sub in ast.walk(node):
+            if isinstance(
+                sub, (ast.Lambda, ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            # Ternary: `fn(...) if rank == 0 else ...` — both arms are
+            # rank-gated once the test is.
+            if isinstance(sub, ast.IfExp) and self._rank_expr(sub.test):
+                self._flag_dispatches(sub.body)
+                self._flag_dispatches(sub.orelse)
+            # Short-circuit: `rank == 0 and fn(...)` — operands after a
+            # rank-derived one only evaluate on some ranks.
+            elif isinstance(sub, ast.BoolOp):
+                tainted = False
+                for operand in sub.values:
+                    if tainted:
+                        self._flag_dispatches(operand)
+                    elif self._rank_expr(operand):
+                        tainted = True
+
+
+def check_rank(repo: "pathlib.Path | None" = None) -> list[Violation]:
+    root = repo_root(repo)
+    sources: list[tuple[str, str, ast.Module]] = []
+    for relpath in ROSTER:
+        path = root / relpath
+        if not path.exists():
+            continue
+        try:
+            src = core.source(path)
+            tree = core.parse(path)
+        except SyntaxError:
+            continue
+        sources.append((relpath, src, tree))
+    index = _Index.build([t for _, _, t in sources])
+    violations: list[Violation] = []
+    for relpath, src, tree in sources:
+        lines = src.splitlines()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                checker = _FnChecker(relpath, node, lines, index)
+                checker.run()
+                violations.extend(checker.violations)
+    uniq: dict[str, Violation] = {}
+    for v in violations:
+        uniq.setdefault(v.key, v)
+    return list(uniq.values())

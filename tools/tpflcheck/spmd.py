@@ -45,6 +45,7 @@ from __future__ import annotations
 import ast
 import pathlib
 
+from tools.tpflcheck import core
 from tools.tpflcheck.core import Violation, py_files, rel, repo_root
 
 #: collective -> positional index of its axis-name argument.
@@ -109,7 +110,7 @@ class _ModuleConstants:
         path = self.root / relpath
         if path.exists():
             try:
-                tree = ast.parse(path.read_text(encoding="utf-8"))
+                tree = core.parse(path)
             except SyntaxError:
                 tree = ast.Module(body=[], type_ignores=[])
             for node in tree.body:
@@ -397,7 +398,7 @@ def check_spmd(repo: "pathlib.Path | None" = None) -> list[Violation]:
     violations: list[Violation] = []
     for path in py_files(root):
         r = rel(root, path)
-        src = path.read_text(encoding="utf-8")
+        src = core.source(path)
         # Cheap textual pre-filter: most modules have no collectives
         # at all — skip the full scope/edge index for them.
         if not any(
@@ -407,7 +408,7 @@ def check_spmd(repo: "pathlib.Path | None" = None) -> list[Violation]:
         ):
             continue
         try:
-            tree = ast.parse(src)
+            tree = core.parse(path)
         except SyntaxError:
             continue
         mod = _ModuleInfo(r, tree, consts)

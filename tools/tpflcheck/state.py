@@ -1,0 +1,411 @@
+"""Checkpoint-state totality lint.
+
+Five subsystems round-trip state through ``export_state`` /
+``import_state`` (engine) or ``state_export`` / ``state_import``
+(controller, membership, population, quarantine), and the window
+pipeline's cadence bookkeeping rides the engine snapshot. A field
+added to one of these classes and NOT added to its export is silent
+state loss: kill-and-resume "works" and quietly resumes from a
+different point (the exact bug class pfl-research calls out — see
+PAPERS.md). This pass makes export totality a review-time failure:
+
+1. **Field totality** — every mutable field of a roster class
+   (assigned in ``__init__`` or an ``attach_*`` method AND re-assigned
+   / mutated anywhere outside ``__init__`` — construction-time config
+   the constructor rebuilds is exempt) must either be READ by that
+   class's export method (resolved one call level deep into same-class
+   helpers), or carry ``# ephemeral: <reason>`` on the declaring
+   assignment (or the contiguous comment block above it). Classes
+   without an export method (``WindowPipeline``, ``WindowPrefetcher``
+   — their durable cadence state rides the ENGINE's snapshot) must
+   annotate every such field.
+2. **Key symmetry** — every snapshot key the export method writes
+   (subscript stores and returned/assigned dict-literal keys, one call
+   level deep) must be consumed by the import method (subscript loads,
+   ``.get``, ``in`` tests against the state parameter, one call level
+   deep), and vice versa. An export-only key is dead weight the resume
+   silently drops (the historical ``seed`` bug this pass found — see
+   pyproject.toml); an import-only key can never arrive.
+
+Annotation grammar: ``# ephemeral: <reason>`` — reason mandatory
+(program caches, derived masks, live thread handles, runtime bindings
+re-established on restore).
+
+Runtime half: ``Settings.STATE_CONTRACTS``
+(:class:`tpfl.management.checkpoint.EngineCheckpointer`) — every save
+immediately re-loads its own serialized snapshot onto a shadow import
+and compares per-key digests, raising ``StateContractError`` with a
+named-field witness. Static totality at review time; the shadow
+round-trip catches what static analysis cannot (a field whose VALUE
+does not survive msgpack).
+
+Waiver keys: ``state:<file>::<Class>.<attr>`` (totality),
+``state:<file>::<Class>[<key>]:export-only`` / ``:import-only``
+(symmetry).
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+
+from tools.tpflcheck import core
+from tools.tpflcheck.core import Violation, repo_root
+
+#: The checkpointed roster: file -> classes whose state round-trips
+#: (or, for the pipeline classes, rides the engine snapshot).
+ROSTER: "tuple[tuple[str, tuple[str, ...]], ...]" = (
+    ("tpfl/parallel/engine.py", ("FederationEngine",)),
+    ("tpfl/parallel/membership.py", ("MembershipView",)),
+    ("tpfl/parallel/population.py", ("ClientPopulation",)),
+    ("tpfl/learning/async_control.py", ("AsyncController",)),
+    ("tpfl/management/quarantine.py", ("QuarantineEngine",)),
+    ("tpfl/parallel/window_pipeline.py", ("WindowPipeline", "WindowPrefetcher")),
+)
+
+_EXPORT_NAMES = ("export_state", "state_export")
+_IMPORT_NAMES = ("import_state", "state_import")
+
+_EPHEMERAL_RE = re.compile(r"#\s*ephemeral:\s*(\S.*)?$")
+
+#: Method calls that mutate a container in place — a field touched
+#: only through these still carries runtime state the resume needs.
+_MUTATOR_CALLS = {
+    "append", "extend", "add", "update", "clear", "pop", "popitem",
+    "setdefault", "remove", "discard", "insert", "appendleft",
+}
+
+
+def _ephemeral_reason(lines: "list[str]", lineno: int) -> "str | None | bool":
+    """``# ephemeral:`` lookup on the line or the contiguous comment
+    block above. Returns the reason string, ``""`` for an annotation
+    missing its reason, or False when unannotated."""
+    candidates = [lines[lineno - 1]]
+    i = lineno - 2
+    while i >= 0 and lines[i].strip().startswith("#"):
+        candidates.append(lines[i])
+        i -= 1
+    for text in candidates:
+        m = _EPHEMERAL_RE.search(text)
+        if m:
+            return (m.group(1) or "").strip()
+    return False
+
+
+def _self_attr(node: ast.AST) -> "str | None":
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _assigned_self_attrs(stmt: ast.stmt) -> "list[tuple[str, int]]":
+    """self attributes a statement (re)binds or mutates in place."""
+    out: list[tuple[str, int]] = []
+
+    def targets_of(node: ast.AST) -> "list[ast.AST]":
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return [t for e in node.elts for t in targets_of(e)]
+        return [node]
+
+    if isinstance(stmt, ast.Assign):
+        tgts = [t for tgt in stmt.targets for t in targets_of(tgt)]
+    elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+        tgts = targets_of(stmt.target)
+    else:
+        tgts = []
+    for t in tgts:
+        attr = _self_attr(t)
+        if attr is not None:
+            out.append((attr, stmt.lineno))
+        elif isinstance(t, ast.Subscript):
+            attr = _self_attr(t.value)
+            if attr is not None:  # self.x[k] = ... mutates x
+                out.append((attr, stmt.lineno))
+    return out
+
+
+class _ClassInfo:
+    def __init__(self, cls: ast.ClassDef) -> None:
+        self.node = cls
+        self.methods: dict[str, ast.FunctionDef] = {
+            f.name: f for f in cls.body if isinstance(f, ast.FunctionDef)
+        }
+        self.export = next(
+            (self.methods[n] for n in _EXPORT_NAMES if n in self.methods),
+            None,
+        )
+        self.importer = next(
+            (self.methods[n] for n in _IMPORT_NAMES if n in self.methods),
+            None,
+        )
+
+    # --- field totality inputs ---
+
+    def declared_fields(self) -> "dict[str, list[int]]":
+        """attr -> declaring assignment lines (``__init__``/``attach_*``)."""
+        decls: dict[str, list[int]] = {}
+        for name, fn in self.methods.items():
+            if name != "__init__" and not name.startswith("attach_"):
+                continue
+            for stmt in ast.walk(fn):
+                if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                    for attr, lineno in _assigned_self_attrs(stmt):
+                        decls.setdefault(attr, []).append(lineno)
+        return decls
+
+    def mutated_fields(self) -> "dict[str, tuple[int, str]]":
+        """attr -> (line, method) of one mutation OUTSIDE ``__init__``."""
+        mutated: dict[str, tuple[int, str]] = {}
+        for name, fn in self.methods.items():
+            if name == "__init__":
+                continue
+            for stmt in ast.walk(fn):
+                if isinstance(stmt, ast.stmt):
+                    for attr, lineno in _assigned_self_attrs(stmt):
+                        mutated.setdefault(attr, (lineno, name))
+                if (
+                    isinstance(stmt, ast.Call)
+                    and isinstance(stmt.func, ast.Attribute)
+                    and stmt.func.attr in _MUTATOR_CALLS
+                ):
+                    attr = _self_attr(stmt.func.value)
+                    if attr is not None:
+                        mutated.setdefault(attr, (stmt.lineno, name))
+        return mutated
+
+    def export_reads(self) -> "set[str]":
+        """self attributes the export method reads, one call level deep
+        into same-class helpers (``self._helper(...)``)."""
+        if self.export is None:
+            return set()
+        reads: set[str] = set()
+        bodies = [self.export]
+        for node in ast.walk(self.export):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and _self_attr(node.func) is not None
+                and node.func.attr in self.methods
+            ):
+                bodies.append(self.methods[node.func.attr])
+        for body in bodies:
+            for node in ast.walk(body):
+                attr = _self_attr(node)
+                if attr is not None and isinstance(node.ctx, ast.Load):
+                    reads.add(attr)
+        return reads
+
+    # --- key symmetry inputs ---
+
+    def _helper_calls(
+        self, fn: ast.FunctionDef, dict_name: "str | None"
+    ) -> "list[tuple[ast.FunctionDef, str | None]]":
+        """Same-class helpers called from ``fn``; when ``dict_name`` is
+        the state-dict variable and it is passed positionally, map it to
+        the helper's matching parameter (the one-hop resolution)."""
+        out: list[tuple[ast.FunctionDef, "str | None"]] = []
+        for node in ast.walk(fn):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and _self_attr(node.func) is not None
+                and node.func.attr in self.methods
+            ):
+                continue
+            helper = self.methods[node.func.attr]
+            params = [a.arg for a in helper.args.args if a.arg != "self"]
+            mapped: "str | None" = None
+            if dict_name is not None:
+                for pos, arg in enumerate(node.args):
+                    if isinstance(arg, ast.Name) and arg.id == dict_name:
+                        if pos < len(params):
+                            mapped = params[pos]
+                        break
+            out.append((helper, mapped))
+        return out
+
+    def export_keys(self) -> "dict[str, int]":
+        """Snapshot keys the export writes: ``x["k"] = ...`` subscript
+        stores plus top-level keys of dict literals returned or bound
+        to a plain name (nested value dicts are the CHILD class's
+        contract, not this one's)."""
+        if self.export is None:
+            return {}
+        keys: dict[str, int] = {}
+        bodies = [self.export] + [h for h, _ in self._helper_calls(self.export, None)]
+        for body in bodies:
+            for node in ast.walk(body):
+                if (
+                    isinstance(node, ast.Subscript)
+                    and isinstance(node.ctx, ast.Store)
+                    and isinstance(node.slice, ast.Constant)
+                    and isinstance(node.slice.value, str)
+                ):
+                    keys.setdefault(node.slice.value, node.lineno)
+                lit: "ast.Dict | None" = None
+                if isinstance(node, ast.Return) and isinstance(node.value, ast.Dict):
+                    lit = node.value
+                elif (
+                    isinstance(node, (ast.Assign, ast.AnnAssign))
+                    and isinstance(node.value, ast.Dict)
+                ):
+                    lit = node.value
+                if lit is not None:
+                    for k in lit.keys:
+                        if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                            keys.setdefault(k.value, k.lineno)
+        return keys
+
+    def import_keys(self) -> "dict[str, int]":
+        """Snapshot keys the import consumes off its state parameter:
+        ``state["k"]`` loads, ``state.get("k", ...)``, ``"k" in state``
+        — one call level deep when the dict is handed to a helper."""
+        if self.importer is None:
+            return {}
+        params = [a.arg for a in self.importer.args.args if a.arg != "self"]
+        if not params:
+            return {}
+        keys: dict[str, int] = {}
+        scopes: list[tuple[ast.FunctionDef, str]] = [(self.importer, params[0])]
+        scopes += [
+            (h, p)
+            for h, p in self._helper_calls(self.importer, params[0])
+            if p is not None
+        ]
+        for body, state_name in scopes:
+            for node in ast.walk(body):
+                if (
+                    isinstance(node, ast.Subscript)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == state_name
+                    and isinstance(node.slice, ast.Constant)
+                    and isinstance(node.slice.value, str)
+                ):
+                    keys.setdefault(node.slice.value, node.lineno)
+                elif (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "get"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == state_name
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                ):
+                    keys.setdefault(node.args[0].value, node.lineno)
+                elif isinstance(node, ast.Compare) and any(
+                    isinstance(op, (ast.In, ast.NotIn)) for op in node.ops
+                ):
+                    if (
+                        isinstance(node.left, ast.Constant)
+                        and isinstance(node.left.value, str)
+                        and any(
+                            isinstance(c, ast.Name) and c.id == state_name
+                            for c in node.comparators
+                        )
+                    ):
+                        keys.setdefault(node.left.value, node.lineno)
+        return keys
+
+
+def check_state(repo: "pathlib.Path | None" = None) -> list[Violation]:
+    root = repo_root(repo)
+    violations: list[Violation] = []
+    for relpath, class_names in ROSTER:
+        path = root / relpath
+        if not path.exists():
+            continue
+        try:
+            src = core.source(path)
+            tree = core.parse(path)
+        except SyntaxError:
+            continue
+        lines = src.splitlines()
+        classes = {
+            n.name: n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)
+        }
+        for cls_name in class_names:
+            if cls_name not in classes:
+                continue
+            info = _ClassInfo(classes[cls_name])
+            export_name = (
+                info.export.name if info.export is not None else None
+            )
+            declared = info.declared_fields()
+            mutated = info.mutated_fields()
+            reads = info.export_reads()
+
+            # 1. field totality
+            for attr in sorted(set(declared) & set(mutated)):
+                if attr in reads:
+                    continue
+                decl_lines = declared[attr]
+                reason = next(
+                    (
+                        r
+                        for ln in decl_lines
+                        if (r := _ephemeral_reason(lines, ln)) is not False
+                    ),
+                    False,
+                )
+                mut_line, mut_method = mutated[attr]
+                if reason is False:
+                    where = (
+                        f"read by {export_name}" if export_name
+                        else "covered by any export method"
+                    )
+                    violations.append(
+                        Violation(
+                            "state", relpath, decl_lines[0],
+                            f"{cls_name}.{attr}: mutable runtime state "
+                            f"(mutated at line {mut_line} in {mut_method}) "
+                            f"is not {where} — checkpoint resume silently "
+                            "loses it; export it or annotate "
+                            "'# ephemeral: <reason>'",
+                            f"state:{relpath}::{cls_name}.{attr}",
+                        )
+                    )
+                elif reason == "":
+                    violations.append(
+                        Violation(
+                            "state", relpath, decl_lines[0],
+                            f"{cls_name}.{attr}: '# ephemeral:' annotation "
+                            "requires a reason",
+                            f"state:{relpath}::{cls_name}.{attr}::reason",
+                        )
+                    )
+
+            # 2. export/import key symmetry
+            if info.export is None or info.importer is None:
+                continue
+            ex_keys = info.export_keys()
+            im_keys = info.import_keys()
+            for key in sorted(set(ex_keys) - set(im_keys)):
+                violations.append(
+                    Violation(
+                        "state", relpath, ex_keys[key],
+                        f"{cls_name}: snapshot key {key!r} is written by "
+                        f"{info.export.name} but never consumed by "
+                        f"{info.importer.name} — resume silently drops it",
+                        f"state:{relpath}::{cls_name}[{key}]:export-only",
+                    )
+                )
+            for key in sorted(set(im_keys) - set(ex_keys)):
+                violations.append(
+                    Violation(
+                        "state", relpath, im_keys[key],
+                        f"{cls_name}: snapshot key {key!r} is consumed by "
+                        f"{info.importer.name} but never written by "
+                        f"{info.export.name} — it can never arrive",
+                        f"state:{relpath}::{cls_name}[{key}]:import-only",
+                    )
+                )
+    uniq: dict[str, Violation] = {}
+    for v in violations:
+        uniq.setdefault(v.key, v)
+    return list(uniq.values())

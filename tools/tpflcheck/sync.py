@@ -45,6 +45,7 @@ import ast
 import pathlib
 import re
 
+from tools.tpflcheck import core
 from tools.tpflcheck.core import Violation, repo_root
 
 #: The hot-path roster: modules where a stray sync costs round overlap.
@@ -256,9 +257,9 @@ def check_sync(repo: "pathlib.Path | None" = None) -> list[Violation]:
         path = root / relpath
         if not path.exists():
             continue
-        src = path.read_text(encoding="utf-8")
         try:
-            tree = ast.parse(src)
+            src = core.source(path)
+            tree = core.parse(path)
         except SyntaxError:
             continue
         lines = src.splitlines()

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import ast
 
+from tools.tpflcheck import core
 from tools.tpflcheck.core import Violation, py_files, rel, repo_root
 
 
@@ -49,7 +50,7 @@ def check_threads(repo=None) -> list[Violation]:
     violations: list[Violation] = []
     for path in py_files(root):
         r = rel(root, path)
-        tree = ast.parse(path.read_text(encoding="utf-8"))
+        tree = core.parse(path)
 
         # Which Call nodes are super().__init__ inside Thread subclasses
         # (those are checked by the subclass rule, not the call rule).

@@ -30,6 +30,7 @@ from __future__ import annotations
 import ast
 import pathlib
 
+from tools.tpflcheck import core
 from tools.tpflcheck.core import Violation, py_files, rel, repo_root
 
 #: Modules exempt from the lint: the management layer implements the
@@ -79,7 +80,7 @@ def check_trace(repo: "pathlib.Path | None" = None) -> list[Violation]:
             continue
         if any(r.startswith(p) for p in EXEMPT_PREFIXES):
             continue
-        tree = ast.parse(path.read_text(encoding="utf-8"))
+        tree = core.parse(path)
         for node in ast.walk(tree):
             if not isinstance(node, ast.Call):
                 continue

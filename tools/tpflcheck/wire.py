@@ -1,8 +1,7 @@
-"""Wire-path lints (moved from the original ``tools/wirecheck.py``).
+"""Wire-path lints.
 
-Three checks, unchanged in behavior, now sharing tpflcheck's walk and
-reporting machinery (``tools/wirecheck.py`` is retired — import this
-module directly; ``python -m tools.tpflcheck`` runs everything):
+Three checks sharing tpflcheck's walk and reporting machinery
+(``python -m tools.tpflcheck`` runs everything):
 
 - :func:`check` — model payloads must go through the codec registry:
   raw ``serialization.encode_pytree`` / ``encode_model_payload`` /

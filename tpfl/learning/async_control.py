@@ -331,6 +331,12 @@ class AsyncController:
                 "k": self._k,
                 "deadline": self._deadline,
                 "trajectory": [dict(r) for r in self._trajectory],
+                # Without this the archived receipt died with the
+                # process: a kill between reset() and the harness's
+                # last_trajectory() read lost the whole experiment log
+                # (the state pass's unexported-field finding; see
+                # tools/tpflcheck/state.py).
+                "last_trajectory": [dict(r) for r in self._last_trajectory],
             }
 
     def state_import(self, state: dict) -> None:
@@ -359,6 +365,8 @@ class AsyncController:
             )
             traj = [dict(r) for r in state.get("trajectory", [])]
             self._trajectory = traj[-_TRAJECTORY_CAP:]
+            last = [dict(r) for r in state.get("last_trajectory", [])]
+            self._last_trajectory = last[-_TRAJECTORY_CAP:]
 
     def reset(self) -> None:
         """Drop all learned state (a controller belongs to one

@@ -173,6 +173,58 @@ def load_node_checkpoint(
 _ENGINE_FILE = "engine.tpfl"
 
 
+class StateContractError(RuntimeError):
+    """A saved engine snapshot failed its own shadow re-import: a key
+    the export wrote did not survive the serialize→restore round-trip
+    (or changed bytes doing so). Carries the first offending field by
+    name. Runtime half of ``tools/tpflcheck``'s state pass
+    (``Settings.STATE_CONTRACTS``)."""
+
+
+def _shadow_verify(state: "dict[str, Any]", payload: bytes) -> None:
+    """Re-load ``payload`` (the serialized snapshot) onto a shadow
+    import and compare per-key digests against the live ``state`` —
+    the static state pass proves export/import key symmetry at review
+    time; this catches what it cannot: a field whose VALUE does not
+    survive msgpack (an unserializable leaf silently coerced, dtype
+    drift, a key dropped by a custom handler)."""
+    import hashlib
+
+    from flax import serialization as flax_ser
+
+    shadow = flax_ser.msgpack_restore(payload)
+    missing = sorted(set(state) - set(shadow))
+    extra = sorted(set(shadow) - set(state))
+    if missing or extra:
+        field = (missing or extra)[0]
+        raise StateContractError(
+            f"engine snapshot key {field!r} "
+            + (
+                "was exported but did not survive the serialize/restore "
+                "round-trip"
+                if missing
+                else "appeared in the restored snapshot without being "
+                "exported"
+            )
+            + f" (missing={missing}, extra={extra}) — the resume would "
+            "silently diverge from the saved run"
+        )
+    for key in sorted(state):
+        a = hashlib.sha256(
+            flax_ser.msgpack_serialize({key: state[key]})
+        ).hexdigest()
+        b = hashlib.sha256(
+            flax_ser.msgpack_serialize({key: shadow[key]})
+        ).hexdigest()
+        if a != b:
+            raise StateContractError(
+                f"engine snapshot key {key!r} changed bytes across the "
+                f"serialize/restore round-trip (exported digest {a[:16]}, "
+                f"shadow digest {b[:16]}) — the resume would silently "
+                "diverge from the saved run"
+            )
+
+
 class EngineCheckpointer:
     """Durable engine-state checkpoints (ISSUE 17 preemption hardening).
 
@@ -208,14 +260,24 @@ class EngineCheckpointer:
 
         import uuid
 
+        from tpfl.settings import Settings
+
         sub = f"ckpt_{uuid.uuid4().hex[:8]}"
         path = os.path.join(self._dir, sub)
         os.makedirs(path)
+        payload = flax_ser.msgpack_serialize(state)
         with open(os.path.join(path, _ENGINE_FILE), "wb") as f:
-            f.write(flax_ser.msgpack_serialize(state))
+            f.write(payload)
         meta = {"step": int(step), "node": self.node, **(extra or {})}
         with open(os.path.join(path, _META_FILE), "w") as f:
             json.dump(meta, f)
+        if Settings.STATE_CONTRACTS:
+            # Shadow re-import BEFORE publication: a snapshot that
+            # cannot faithfully restore must never become LATEST — the
+            # prior good checkpoint stays published and the unpublished
+            # subdir is swept like any crash orphan
+            # (StateContractError names the offending field).
+            _shadow_verify(state, payload)
         _publish(self._dir, sub)
         return sub
 

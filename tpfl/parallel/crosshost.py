@@ -56,6 +56,7 @@ _KNOBS = (
     "WIRE_TOPK_FRAC",
     "ENGINE_TELEMETRY",
     "ENGINE_DONATE",
+    "RANK_CONTRACTS",
 )
 
 
@@ -80,6 +81,7 @@ def demo_run(
     rounds: int = 2,
     seed: int = 0,
     algorithm: str = "fedavg",
+    fork_rank: Optional[int] = None,
 ) -> dict:
     """One deterministic engine federation under the current knobs.
 
@@ -87,12 +89,24 @@ def demo_run(
     ANY topology — 1 process × 8 devices, 2 × 4, forced
     ``SHARD_HOSTS`` — so results from different worlds are directly
     comparable (allclose across topologies; byte-equal within one).
+
+    ``fork_rank`` is the divergence-proof harness: that rank (and only
+    it) dispatches one extra rank-LOCAL program after the shared run,
+    so its ``RANK_CONTRACTS`` receipt forks from the fleet's and
+    :func:`launch`'s cross-rank comparison must fail with a (rank,
+    ordinal, key) witness — the negative control proving the receipts
+    actually detect divergence.
     """
     import jax
 
     from tpfl.models import MLP
+    from tpfl.parallel import ranksafe
     from tpfl.parallel.engine import FederationEngine, auto_mesh
     from tpfl.parallel.mesh import mesh_axis_size, replicated, HOST_AXIS
+
+    # One receipt per run: dispatches recorded before this harness
+    # entered (in-process callers) must not ride this run's receipt.
+    ranksafe.clear()
 
     rng = np.random.default_rng(seed)
     xs = rng.random((nodes, 1, 8, 8, 8), np.float32)
@@ -112,6 +126,21 @@ def demo_run(
     p, losses = eng.run_rounds(
         p, dx, dy, weights=w, n_rounds=rounds, donate=False
     )
+
+    # rank-dependent: deliberate divergence harness — the probe engine
+    # is mesh=None (rank-local, no collectives, cannot hang the world);
+    # its extra dispatch forks THIS rank's receipt so launch()'s
+    # cross-rank comparison must fail with a named witness.
+    if fork_rank is not None and jax.process_index() == int(fork_rank):
+        probe = FederationEngine(
+            MLP(hidden_sizes=(8,)), 2, mesh=None, seed=seed,
+            algorithm=algorithm, learning_rate=0.1,
+        )
+        probe.run_rounds(
+            probe.init_params((8, 8)),
+            *probe.shard_data(xs[:2], ys[:2]),
+            n_rounds=1, donate=False,
+        )
 
     def fetch(x: Any) -> np.ndarray:
         # Multi-process outputs are global (not fully addressable):
@@ -155,6 +184,10 @@ def demo_run(
         )
     return {
         "loss_mean": float(np.mean(fetch(losses)[:nodes])),
+        # Ordered (cache key, HLO fingerprint) digests of every
+        # program THIS process dispatched — empty unless
+        # Settings.RANK_CONTRACTS armed the engine's recording.
+        "program_digests": ranksafe.receipt(),
         "dcn_bytes_per_round": int(dcn_bytes),
         "global": global_row.tolist(),
         "losses": fetch(losses)[:nodes].astype(np.float64).tolist(),
@@ -179,11 +212,13 @@ def worker_main() -> int:
     ensure_distributed()
     cfg = json.loads(os.environ.get("TPFL_CROSSHOST_CFG", "{}") or "{}")
     _apply_knobs(cfg.get("knobs"))
+    fork = cfg.get("fork_rank")
     result = demo_run(
         nodes=int(cfg.get("nodes", 8)),
         rounds=int(cfg.get("rounds", 2)),
         seed=int(cfg.get("seed", 0)),
         algorithm=str(cfg.get("algorithm", "fedavg")),
+        fork_rank=int(fork) if fork is not None else None,
     )
     out = os.environ.get("TPFL_CROSSHOST_OUT")
     if out:
@@ -204,6 +239,7 @@ def launch(
     algorithm: str = "fedavg",
     knobs: Optional[dict] = None,
     timeout: float = 420.0,
+    fork_rank: Optional[int] = None,
 ) -> list[dict]:
     """Fork ``num_processes`` gloo workers and return their results.
 
@@ -213,6 +249,14 @@ def launch(
     untouched). Raises on any worker failure, with the worker's
     stderr tail in the message — the CI failure must say WHY a rank
     died, not just that it did.
+
+    When the workers ran with ``RANK_CONTRACTS`` (via ``knobs``), each
+    receipt carries the ordered program-dispatch digests and the
+    parent verifies all ranks issued the identical sequence
+    (:func:`tpfl.parallel.ranksafe.compare_receipts`) — a divergence
+    raises with the first (rank, ordinal, key) witness instead of
+    hanging a real fleet on DCN. ``fork_rank`` deliberately breaks one
+    rank's sequence (see :func:`demo_run`) to prove the check fires.
     """
     port = free_port()
     out_prefix = os.path.join(
@@ -233,6 +277,7 @@ def launch(
             "seed": seed,
             "algorithm": algorithm,
             "knobs": dict(knobs or {}),
+            "fork_rank": fork_rank,
         }
     )
     procs = []
@@ -280,6 +325,14 @@ def launch(
     for pid in range(num_processes):
         with open(f"{out_prefix}.{pid}.json") as f:
             results.append(json.load(f))
+    receipts = [r.get("program_digests") or [] for r in results]
+    if any(receipts):
+        # RANK_CONTRACTS receipts present: the fleet must have issued
+        # ONE program sequence (ranksafe is pure stdlib — the parent
+        # verifies without importing jax).
+        from tpfl.parallel.ranksafe import compare_receipts
+
+        compare_receipts(receipts)
     return results
 
 

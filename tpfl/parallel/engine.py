@@ -109,6 +109,7 @@ from tpfl.learning.jax_learner import (
     make_train_step,
 )
 from tpfl.management import profiling
+from tpfl.parallel import ranksafe
 from tpfl.parallel.compat import shard_map
 from tpfl.parallel.distributed import global_put, is_multiprocess
 from tpfl.parallel.mesh import (
@@ -672,19 +673,34 @@ class FederationEngine:
         self.prox_mu = float(prox_mu)
         #: Stacked leading dimension: n_nodes rounded up to a device
         #: multiple (== n_nodes without a mesh).
+        # ephemeral: derived — resize_nodes/import_state re-derive it
+        # from the checkpointed n_nodes on this mesh.
         self.padded_nodes = padded_node_count(self.n_nodes, self.mesh)
         # unguarded: single-owner — an engine is built and driven by one
         # thread (a learner's fit thread or the bench); the caches below
         # are only touched from that thread.
+        # ephemeral: compiled-program cache — rebuilt per mesh/process
+        # (the persistent XLA cache makes rebuilds warm, not a resume
+        # concern).
         self._programs: dict[tuple, Callable] = {}
         # unguarded: single-owner (see _programs)
+        # ephemeral: observatory/contract wrappers over _programs.
         self._wrapped: dict[tuple, Callable] = {}
         # unguarded: single-owner (see _programs)
+        # ephemeral: compiled-program cache (see _programs).
         self._eval_fns: dict[bool, Callable] = {}
+        # unguarded: single-owner (see _programs) — per-cache-key
+        # lowered-HLO fingerprints for the RANK_CONTRACTS dispatch
+        # receipts (tpfl.parallel.ranksafe); computed lazily once per
+        # key, only when the knob is on.
+        # ephemeral: derived from _programs (see _programs).
+        self._hlo_digests: dict[tuple, str] = {}
         # unguarded: single-owner (see _programs) — the per-arg
         # sharding pytrees of the most recent _prepare_args placement;
         # the 2D program builder lowers with them so buffer donation
         # aliases instead of freeing (see _model_mesh_shardings).
+        # ephemeral: per-dispatch scratch — recomputed by every
+        # _prepare_args call, meaningless across a resume.
         self._arg_shardings: Optional[tuple] = None
         # unguarded: single-owner (see _programs) — dispatch-window
         # ordinal for round-profiler attribution labels.
@@ -712,6 +728,8 @@ class FederationEngine:
         self.population: Optional[Any] = None
         #: [padded_nodes] 1/0 mask of real vs pad rows (the uniform
         #: fallback denominator when a round's weights are all-zero).
+        # ephemeral: derived — resize_nodes/import_state re-derive it
+        # from the checkpointed n_nodes (see padded_nodes).
         self.valid = valid_node_mask(self.n_nodes, self.padded_nodes)
         if Settings.COMPILE_CACHE_DIR:
             # Persistent compilation cache (COMPILE_CACHE_DIR): warm
@@ -1000,6 +1018,13 @@ class FederationEngine:
             self.resize_nodes(n)
         self._rounds_done = int(state.get("rounds_done", 0))
         self._windows = int(state.get("windows", 0))
+        # The checkpointed seed wins over this engine's construction
+        # seed: the per-window RNG streams (and the population's seeded
+        # cohorts via the engine plumb) must continue the killed run's
+        # sequence — resuming onto a differently-seeded engine used to
+        # silently fork the stream (the state pass's export-only-key
+        # finding; see tools/tpflcheck/state.py).
+        self.seed = int(state.get("seed", self.seed))
 
         def place(tree: Any) -> Any:
             return self._shard_state(self.pad_stacked(tree))
@@ -2274,6 +2299,22 @@ class FederationEngine:
                     "POPULATION_CLIENTS": int(pop_size),
                 },
             )
+        if Settings.RANK_CONTRACTS:
+            # Dispatch receipt: append this program's (cache key,
+            # lowered-HLO fingerprint) digest to the per-process
+            # ordered log — crosshost.launch compares the sequences
+            # across ranks (tpfl.parallel.ranksafe, the rank pass's
+            # runtime half).
+            receipt_key = (
+                kind, int(epochs), int(n_rounds), int(w.ndim),
+                bool(donate), bool(tele_on), int(a_ndim), int(codec),
+                float(frac), int(model_axes), str(mesh_layout),
+                bool(fedbuff), float(stale_exp), int(capacity),
+                int(mesh_nodes), int(mesh_hosts), int(pop_size),
+            )
+            ranksafe.record_dispatch(
+                receipt_key, self._hlo_digest(receipt_key, args)
+            )
 
         prof = profiling.rounds.enabled()
         node_tag = f"engine:{profiling.module_tag(self.module)}"
@@ -2305,6 +2346,25 @@ class FederationEngine:
             n_rounds, window_start, self._windows, prof, node_tag,
             t0, t1,
         )
+
+    def _hlo_digest(self, key: tuple, args: tuple) -> str:
+        """Lowered-HLO fingerprint of the cached program behind
+        ``key``, traced lazily once per cache key (RANK_CONTRACTS
+        only): two ranks agreeing on the key but lowering different
+        bytes — layout drift, version skew — must still diverge in the
+        receipt. Lowering re-traces without executing, so donated
+        inputs are untouched; any backend that cannot lower here
+        degrades to a key-only digest rather than failing dispatch."""
+        fp = self._hlo_digests.get(key)
+        if fp is None:
+            try:
+                fp = ranksafe.hlo_fingerprint(
+                    self._programs[key].lower(*args).as_text()
+                )
+            except Exception:
+                fp = ""
+            self._hlo_digests[key] = fp
+        return fp
 
     def _dump_flight(self, exc: Exception, kind: str, n_rounds: int) -> None:
         """Black-box the failed dispatch: an ``engine_failure`` event

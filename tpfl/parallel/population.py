@@ -90,6 +90,8 @@ class ClientPopulation:
         # client has folded. int keys in memory; stringified for the
         # msgpack checkpoint (state_export).
         self.clients: dict[int, dict] = {}
+        # ephemeral: runtime binding — re-established by bind() when
+        # the restored population re-attaches (import_state calls it).
         self._engine: Optional[Any] = None
 
     # --- engine binding ---------------------------------------------------

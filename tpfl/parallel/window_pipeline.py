@@ -105,8 +105,12 @@ class WindowPrefetcher:
         self._fn = fn
         self._name = name
         self._lock = concurrency.make_lock("WindowPrefetcher._lock")
+        # ephemeral: live thread handle — always joined before the next
+        # stage and on close(); nothing to resume.
         self._thread: Optional[threading.Thread] = None
         # guarded-by: _lock — (window_index, staged_data, error)
+        # ephemeral: in-flight staged data — re-staged from the data
+        # supplier on the next run; device buffers cannot checkpoint.
         self._slot: Optional[tuple] = None
 
     def start(self, widx: int, start_round: int, n_rounds: int) -> None:
@@ -187,10 +191,16 @@ class WindowPipeline:
         self.engine = engine
         # unguarded: written only by the run() thread; cross-thread
         # readers (bench/tests) read after run() returns.
+        # ephemeral: per-run diagnostics — every run() resets them; the
+        # durable cadence state rides the engine snapshot
+        # (_materialize_snapshot -> engine.export_state).
         self.idle_gaps: list[float] = []
+        # ephemeral: per-run diagnostics (see idle_gaps).
         self.windows_run = 0
         # Cross-thread stop flag (interrupt_for / Node.stop) — honored
         # at exactly the between-dispatch granularity should_stop is.
+        # ephemeral: live control signal — a resumed run starts
+        # unaborted by construction.
         self._abort = threading.Event()
 
     def interrupt(self) -> None:

@@ -850,6 +850,31 @@ class Settings:
     program BUILD time like ``LOCK_TRACING``; off by default (zero
     wrappers, zero per-dispatch reads)."""
 
+    STATE_CONTRACTS: bool = False
+    """Opt-in checkpoint self-verification
+    (``tpfl.management.checkpoint``): every ``EngineCheckpointer.save``
+    immediately re-loads its own serialized snapshot onto a shadow
+    import and compares per-key digests against the live state dict —
+    a key that does not survive the serialize/restore round-trip (or
+    changes bytes doing so) raises ``StateContractError`` naming the
+    field, BEFORE the snapshot is published as LATEST. The runtime
+    half of ``tools/tpflcheck``'s state pass (the static half proves
+    export/import totality at review time; this catches value-level
+    loss static analysis cannot see). Read per save; off by default
+    (zero extra serialization work)."""
+
+    RANK_CONTRACTS: bool = False
+    """Opt-in multi-host dispatch receipts (``tpfl.parallel.ranksafe``):
+    every engine window dispatch appends the digest of its program
+    cache key + lowered-HLO fingerprint to an ordered per-process log,
+    and ``crosshost.launch`` compares the receipts across ranks —
+    divergence fails with the first (rank, ordinal, key) witness
+    instead of hanging the fleet on DCN. The runtime half of
+    ``tools/tpflcheck``'s rank pass (the static half proves no
+    dispatch is rank-gated at review time; receipts catch
+    data-dependent divergence). Read per dispatch; off by default
+    (zero recording, zero extra traces)."""
+
     LOCK_TRACING: bool = False
     """Opt-in runtime lock-order tracing (tpfl.concurrency): every lock
     built through ``make_lock`` becomes a ``TracedLock`` that records
@@ -910,6 +935,13 @@ class Settings:
         cls.FILE_LOGGER = False
         cls.LOCK_TRACING = False
         cls.TRACE_CONTRACTS = False
+        # Contracts ON in tests: every checkpoint save shadow-verifies
+        # its own round-trip and every engine dispatch logs its
+        # program digest — the suite exercises both runtime halves
+        # continuously, so a totality regression fails loudly here
+        # before it ever reaches a fleet.
+        cls.STATE_CONTRACTS = True
+        cls.RANK_CONTRACTS = True
         # Exactness first in tests: dense payloads (v3 zero-copy layout
         # — still exact), no residual gossip; codec tests opt in
         # explicitly. Zero-copy stays byte-path (INPROC_ZERO_COPY off)
@@ -1054,6 +1086,8 @@ class Settings:
         cls.WIRE_CHUNK_SIZE = 256 * 1024
         cls.LOCK_TRACING = False
         cls.TRACE_CONTRACTS = False
+        cls.STATE_CONTRACTS = False
+        cls.RANK_CONTRACTS = False
         # Single-host, handful of nodes: bytes are not the bottleneck —
         # keep the exact dense wire (reference-parity behavior; the v3
         # layout is exact, only the framing differs). By-reference
@@ -1206,6 +1240,12 @@ class Settings:
         cls.WIRE_CHUNK_SIZE = 256 * 1024
         cls.LOCK_TRACING = False
         cls.TRACE_CONTRACTS = False
+        # Scale keeps both contract verifiers OFF: the shadow re-import
+        # doubles checkpoint serialization work and the dispatch
+        # receipts add a trace per cache key — diagnostics a production
+        # fleet arms selectively, not a standing tax.
+        cls.STATE_CONTRACTS = False
+        cls.RANK_CONTRACTS = False
         # Hundreds of round-result waiters waking 2x/s each is a
         # standing GIL tax on the trainers forming the aggregate they
         # wait for; the event still wakes them INSTANTLY on FullModel
