@@ -1016,6 +1016,181 @@ def _crosshost_tier(extra: dict) -> None:
         extra["crosshost_error"] = str(e)[:300]
 
 
+def _fleetobs_tier(extra: dict) -> None:
+    """Fleet observatory tier (ISSUE 20). Four receipts, all CPU-safe:
+
+    - extra.fleetobs determinism: two same-seed 2-process
+      ``jax.distributed`` launches under ENGINE_TELEMETRY; folding
+      each run's worker receipts (``fleetobs.fold_receipts``) must
+      yield ONE fleet registry with ``origin=<rank>`` labels whose
+      Prometheus rendering is byte-identical across the runs.
+    - extra.fleetobs watchdog: a deterministically-driven SLO
+      watchdog (injectable ``now=``) must flag a ~20% rounds/sec
+      regression within 2 evaluation windows, while the uninjected
+      same-length A run stays silent — the alert fires on real
+      regressions and ONLY on real regressions.
+    - extra.fleetobs overhead: the observatory's per-round cost
+      (population fan-out + fleet gauges + snapshot publish + one
+      watchdog window) measured INSIDE a live sampled-population
+      round loop must stay <= 5% of the round wall clock.
+    - extra.fleetobs pop_sketch: the census sweep 100k -> 1M with
+      K=100 must hold a bounded peak-RSS delta, and the coverage
+      bitset must cost EXACTLY (census+7)//8 bytes — the one
+      O(census) concession, priced in bits.
+    """
+    try:
+        import resource
+        import tempfile
+
+        import numpy as np
+
+        from tpfl.management import fleetobs
+        from tpfl.management.telemetry import MetricsRegistry
+        from tpfl.parallel import ClientPopulation
+        from tpfl.parallel.crosshost import launch
+
+        fo: dict = {}
+
+        # --- merged-view determinism across same-seed launches -------
+        texts = []
+        for _ in range(2):
+            res = launch(
+                num_processes=2, devices_per_proc=4, rounds=2,
+                knobs={"SHARD_NODES": True, "SHARD_HOSTS": 0,
+                       "ENGINE_TELEMETRY": True},
+            )
+            texts.append(
+                fleetobs.fold_receipts(res).render_prometheus()
+            )
+        fo["origin_labels_present"] = bool(
+            'origin="0"' in texts[0] and 'origin="1"' in texts[0]
+        )
+        fo["merged_byte_identical"] = bool(texts[0] == texts[1])
+
+        # --- watchdog catch: injected regression vs silent A run -----
+        def drive(rates):
+            reg = MetricsRegistry()
+            wd = fleetobs.SLOWatchdog(
+                "rate(tpfl_engine_rounds_total) >= 2.4", registry=reg,
+                node="bench-watchdog",
+            )
+            wd.evaluate(now=0.0)  # warm the rate state
+            t, windows_after_injection = 0.0, None
+            for i, rate in enumerate(rates):
+                t += 1.0
+                reg.counter("tpfl_engine_rounds_total", rate)
+                wd.evaluate(now=t)
+                if rate < 2.4 and windows_after_injection is None:
+                    windows_after_injection = 0
+                if windows_after_injection is not None:
+                    windows_after_injection += 1
+                    if not wd.healthy():
+                        return windows_after_injection
+            return None  # never breached
+
+        healthy = [2.5] * 8
+        injected = [2.5] * 4 + [2.0] * 6  # ~20% rounds/sec regression
+        fo["uninjected_silent"] = bool(drive(healthy) is None)
+        caught = drive(injected)
+        fo["windows_to_breach"] = caught
+        fo["watchdog_catch_within_2"] = bool(
+            caught is not None and caught <= 2
+        )
+
+        # --- observatory overhead on a live engine round loop --------
+        # A/B the SAME sampled-population federation round with and
+        # without the fleet plane (population fan-out + fleet gauges
+        # + snapshot publish + one watchdog window); median per-round
+        # time keeps one scheduler hiccup from deciding the gate.
+        from tpfl.models import MLP
+        from tpfl.parallel import FederationEngine
+
+        import jax
+
+        K, R_obs = 64, 10
+        eng = FederationEngine(
+            MLP(hidden_sizes=(256, 256)), K, mesh=None, seed=0,
+            learning_rate=0.1,
+        )
+        pop = ClientPopulation(registered=100_000, sample=K, seed=0)
+        eng.attach_population(pop)
+        pub = fleetobs.FleetPublisher(
+            "bench", directory=tempfile.mkdtemp(prefix="tpfl_fleetobs_"),
+        )
+        wd = fleetobs.SLOWatchdog(
+            "rate(tpfl_pop_folded_total) >= 0.0", node="bench-overhead"
+        )
+        rng = np.random.default_rng(0)
+        xs_k = rng.random((K, 1, 64, 8, 8), np.float32)
+        ys_k = rng.integers(0, 10, (K, 1, 64)).astype(np.int32)
+        p = eng.init_params((8, 8))
+        dx, dy = eng.shard_data(xs_k, ys_k)
+
+        def one_round(fleet_plane, r=0):
+            nonlocal p
+            ids = pop.begin_round()
+            w = pop.round_weights(ids, cutoff_frac=0.1)
+            p, _ = eng.run_rounds(p, dx, dy, weights=w, donate=False)
+            # Block: the A/B prices the observatory against a REAL
+            # round, not against JAX's async dispatch returning early.
+            jax.block_until_ready(p)
+            pop.complete_round(ids, w)
+            if fleet_plane:
+                fleetobs.emit_fleet_gauges("bench")
+                wd.evaluate()
+                if r % 10 == 0:
+                    # The deployed publisher is PERIODIC
+                    # (FLEETOBS_SNAPSHOT_PERIOD), not per-round —
+                    # amortize one snapshot write per 10 rounds.
+                    pub.publish_once()
+
+        def median_round_s(fleet_plane):
+            times = []
+            for r in range(R_obs):
+                t0 = time.monotonic()
+                one_round(fleet_plane, r=r + 1)
+                times.append(time.monotonic() - t0)
+            return sorted(times)[len(times) // 2]
+
+        one_round(True)  # warmup: compile + first publish
+        base_s = median_round_s(False)
+        fleet_s = median_round_s(True)
+        overhead = max(0.0, fleet_s - base_s) / max(base_s, 1e-9)
+        fo["rounds_per_sec"] = round(1.0 / max(fleet_s, 1e-9), 2)
+        fo["overhead_frac"] = round(overhead, 4)
+        fo["overhead_within_budget"] = bool(overhead <= 0.05)
+
+        # --- population sketches: bounded RSS on the census sweep ----
+        def sweep(census):
+            p = ClientPopulation(registered=census, sample=100, seed=5)
+            for _ in range(3):
+                ids = p.begin_round()
+                p.complete_round(ids, p.round_weights(ids, 0.1))
+            return p
+
+        rss0 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        small = sweep(100_000)
+        big = sweep(1_000_000)
+        rss1 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        delta_mb = max(0.0, (rss1 - rss0) / 1024.0)
+        fo["pop_sketch"] = {
+            "census_sweep": [100_000, 1_000_000],
+            "rss_delta_mb": round(delta_mb, 1),
+            # O(census) records would cost hundreds of MB at 1M; the
+            # sketches are a bitset + O(touched) dicts.
+            "rss_bounded": bool(delta_mb < 64.0),
+            "bitset_bytes_exact": bool(
+                small._coverage.nbytes == (100_000 + 7) // 8
+                and big._coverage.nbytes == (1_000_000 + 7) // 8
+            ),
+            "coverage_1m": round(big.coverage, 6),
+            "fairness_1m": round(big.fairness, 6),
+        }
+        extra["fleetobs"] = fo
+    except Exception as e:
+        extra["fleetobs_error"] = str(e)[:300]
+
+
 #: Named tiers ``--tiers`` selects from. The device tiers need a real
 #: accelerator to mean anything; the rest are CPU-safe (the CI
 #: perf-smoke job runs ``--tiers profiling --check ...``).
@@ -1024,7 +1199,7 @@ TIERS = (
     "multichip", "wire", "serde", "chaos", "analysis", "telemetry",
     "profiling", "ledger", "byzantine", "async", "engine_obs",
     "engine_wire", "engine_async", "elastic", "transformer_fed",
-    "crosshost",
+    "crosshost", "fleetobs",
 )
 
 
@@ -4068,6 +4243,9 @@ def main() -> None:
 
     if "crosshost" in tiers:
         _crosshost_tier(extra)
+
+    if "fleetobs" in tiers:
+        _fleetobs_tier(extra)
 
     # Only quantitative anchor in the reference: 2-round MNIST e2e must
     # fit in 240 s (node_test.py:105) -> 0.00833 rounds/s floor.

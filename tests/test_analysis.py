@@ -508,6 +508,80 @@ def test_events_fixture(tmp_path):
     assert check_events(root3) == []
 
 
+METRICS_BAD = """\
+    from tpfl.management.telemetry import metrics
+
+
+    def taps(node, kind):
+        metrics.counter("tpfl_rogue_total", labels={"node": node})
+        metrics.gauge("tpfl_engine_loss", 0.5, labels={"node": node})
+        metrics.observe("tpfl_pop_staleness", 1.0)
+        metrics.gauge(f"tpfl_system_{kind}", 1.0)
+        metrics.gauge(f"tpfl_mystery_{kind}", 1.0)
+"""
+
+METRICS_DOC = """\
+    # Metric name reference
+
+    | Metric | Type |
+    |---|---|
+    | `tpfl_engine_{loss,delta_norm}` | gauge |
+    | `tpfl_pop_staleness` | histogram |
+    | `tpfl_system_{cpu_percent,net_*}` | gauge |
+    | `tpfl_rogue_total` | counter |
+    | `tpfl_mystery_*` | gauge |
+"""
+
+
+def test_metrics_fixture(tmp_path):
+    """Every tpfl_* series name a counter/gauge/observe call registers
+    must appear in docs/observability.md — undocumented names (and
+    f-string families with no doc coverage) fail; brace families,
+    wildcards and label annotations in the doc all count as
+    documentation."""
+    from tools.tpflcheck import check_metrics
+
+    doc_missing = {
+        "docs/observability.md": "| `tpfl_engine_{loss,delta_norm}` | g |\n"
+        "| `tpfl_pop_staleness` | h |\n"
+    }
+    root = _mini_repo(
+        tmp_path, {"tpfl/taps.py": METRICS_BAD, **doc_missing}
+    )
+    found = check_metrics(root)
+    assert {v.key for v in found} == {
+        "metrics:tpfl_rogue_total",
+        "metrics:tpfl_system_",
+        "metrics:tpfl_mystery_",
+    }, [v.render() for v in found]
+    root2 = _mini_repo(
+        tmp_path / "ok",
+        {
+            "tpfl/taps.py": METRICS_BAD,
+            "docs/observability.md": METRICS_DOC,
+        },
+    )
+    assert check_metrics(root2) == []
+    # Label annotations (`tpfl_mfu{program}`) document the base name;
+    # non-tpfl names are out of the lint's contract entirely.
+    labeled = """\
+        from tpfl.management.telemetry import metrics
+
+
+        def taps():
+            metrics.gauge("tpfl_mfu", 0.5, labels={"program": "x"})
+            metrics.counter("other_counter_total")
+    """
+    root3 = _mini_repo(
+        tmp_path / "lab",
+        {
+            "tpfl/taps.py": labeled,
+            "docs/observability.md": "| `tpfl_mfu{program}` | gauge |\n",
+        },
+    )
+    assert check_metrics(root3) == []
+
+
 # --- capture: trace-capture totality (ISSUE 14) ---------------------------
 
 

@@ -238,6 +238,40 @@ def test_two_process_gloo_matches_single_process():
     )
 
 
+def test_two_process_fleet_registry_merges_and_is_deterministic():
+    """ISSUE-20 acceptance: each worker's receipt carries a filtered
+    snapshot of its MetricsRegistry; folding the receipts yields ONE
+    fleet registry whose series wear ``origin=<rank>`` labels — and
+    the merged Prometheus rendering is byte-identical across two
+    same-seed launches (the deterministic engine series make the
+    whole fleet view a pure function of the run)."""
+    from tpfl.management import fleetobs
+
+    knobs = {"SHARD_NODES": True, "SHARD_HOSTS": 0,
+             "ENGINE_TELEMETRY": True}
+    texts = []
+    for _ in range(2):
+        res = launch(
+            num_processes=2, devices_per_proc=4, rounds=2, knobs=knobs
+        )
+        for r in res:
+            snap = r["metrics_snapshot"]
+            assert snap["origin"] == str(r["process_id"])
+            assert snap["counters"] or snap["gauges"], (
+                "ENGINE_TELEMETRY workers must ship engine series"
+            )
+            for kind in ("counters", "gauges"):
+                assert all(
+                    s.startswith(fleetobs.DETERMINISTIC_PREFIXES)
+                    for s in snap[kind]
+                )
+        fleet = fleetobs.fold_receipts(res)
+        texts.append(fleet.render_prometheus())
+    assert 'origin="0"' in texts[0] and 'origin="1"' in texts[0]
+    assert "tpfl_engine_rounds_total" in texts[0]
+    assert texts[0] == texts[1]  # byte-identical merged fleet view
+
+
 # --- (e) RANK_CONTRACTS: the rank pass's runtime half (ISSUE 19) -----------
 
 

@@ -1,0 +1,490 @@
+"""Fleet observatory tests (ISSUE 20): cross-host metric federation
+(snapshot/fold round-trips, origin labels, byte determinism), the
+periodic file publisher, the population observatory fan-out +
+traceview join, the SLO watchdog (grammar, EWMA breach detection,
+one-shot firing and re-arm), and the new HTTP endpoints
+(``/healthz``, ``/fleet.json``, live ``--fleet`` scrapes).
+
+The 2-process crosshost leg (merged fleet registry from worker
+receipts, byte-identical across same-seed runs) lives in
+tests/test_crosshost.py next to the other subprocess checks.
+"""
+
+import json
+import pathlib
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from tpfl.management import fleetobs
+from tpfl.management.fleetobs import (
+    DETERMINISTIC_PREFIXES,
+    FleetPublisher,
+    SLOWatchdog,
+    fold,
+    fold_receipts,
+    load_fleet_dir,
+    parse_targets,
+    registry_from_snapshot,
+    snapshot,
+)
+from tpfl.management.telemetry import MetricsRegistry, flight, metrics
+from tpfl.settings import Settings
+
+
+def _sample_registry(scale: float = 1.0) -> MetricsRegistry:
+    reg = MetricsRegistry()
+    reg.counter("tpfl_engine_rounds_total", 3 * scale, labels={"model": "m"})
+    reg.gauge("tpfl_engine_loss", 0.25 * scale, labels={"model": "m"})
+    reg.observe(
+        "tpfl_pop_staleness", 2.0 * scale,
+        buckets=fleetobs.POP_STALENESS_BUCKETS,
+    )
+    reg.gauge("tpfl_system_cpu_percent", 50.0)  # outside the filter
+    return reg
+
+
+# --- snapshot / fold round-trip ------------------------------------------
+
+
+def test_snapshot_roundtrip_and_prefix_filter():
+    reg = _sample_registry()
+    snap = snapshot(reg, origin="r0", prefixes=DETERMINISTIC_PREFIXES)
+    assert snap["origin"] == "r0"
+    # The wall-clock series is filtered out; deterministic ones stay.
+    assert "tpfl_system_cpu_percent" not in json.dumps(snap)
+    assert snap["counters"]["tpfl_engine_rounds_total{model=m}"] == 3.0
+    # Histogram ships its raw row + its bucket edges.
+    assert snap["buckets"]["tpfl_pop_staleness"] == list(
+        fleetobs.POP_STALENESS_BUCKETS
+    )
+    # JSON-safe: survives a dump/load cycle (the receipt transport).
+    snap = json.loads(json.dumps(snap))
+    back = registry_from_snapshot(snap)
+    folded = back.fold()
+    assert folded["counters"][
+        ("tpfl_engine_rounds_total", (("model", "m"),))
+    ] == 3.0
+    assert folded["gauges"][("tpfl_engine_loss", (("model", "m"),))] == 0.25
+    hist = folded["histograms"][("tpfl_pop_staleness", ())]
+    assert hist[-1] == 1 and hist[-2] == 2.0
+    # Unfiltered snapshot keeps everything.
+    assert (
+        "tpfl_system_cpu_percent"
+        in json.dumps(snapshot(reg, origin="r0"))
+    )
+
+
+def test_fold_origin_labels_and_order_independence():
+    s0 = snapshot(_sample_registry(1.0), origin="0")
+    s1 = snapshot(_sample_registry(2.0), origin="1")
+    merged = fold([s0, s1])
+    text = merged.render_prometheus()
+    assert 'origin="0"' in text and 'origin="1"' in text
+    assert 'tpfl_engine_rounds_total{model="m",origin="1"} 6' in text
+    # Arrival order cannot perturb the rendered bytes.
+    assert fold([s1, s0]).render_prometheus() == text
+    # Same inputs ⇒ byte-identical merged view (the determinism the
+    # crosshost receipt gate pins across whole subprocess runs).
+    assert fold(
+        [json.loads(json.dumps(s0)), json.loads(json.dumps(s1))]
+    ).render_prometheus() == text
+
+
+def test_fold_receipts_skips_snapshotless_ranks():
+    s0 = snapshot(_sample_registry(), origin="0")
+    merged = fold_receipts(
+        [{"metrics_snapshot": s0}, {"loss_mean": 1.0}, {}]
+    )
+    assert 'origin="0"' in merged.render_prometheus()
+
+
+# --- the file publisher ---------------------------------------------------
+
+
+def test_publisher_and_fleet_dir_fold(tmp_path):
+    d = str(tmp_path)
+    for origin, scale in (("0", 1.0), ("1", 2.0)):
+        pub = FleetPublisher(
+            origin, directory=d, registry=_sample_registry(scale),
+            prefixes=DETERMINISTIC_PREFIXES,
+        )
+        path = pub.publish_once()
+        assert pathlib.Path(path).name == f"fleetsnap-{origin}.json"
+    # A torn/garbage file is skipped, never fatal.
+    (tmp_path / "fleetsnap-torn.json").write_text("{not json")
+    snaps = load_fleet_dir(d)
+    assert [s["origin"] for s in snaps] == ["0", "1"]
+    merged = fleetobs.fleet_from_dir(d)
+    text = merged.render_prometheus()
+    assert 'origin="0"' in text and 'origin="1"' in text
+    # Empty / missing dirs fold to an empty registry.
+    assert load_fleet_dir(str(tmp_path / "nope")) == []
+    assert fleetobs.fleet_from_dir(str(tmp_path / "nope")).fold()[
+        "counters"
+    ] == {}
+
+
+def test_publisher_disabled_without_dir():
+    pub = FleetPublisher("x", directory="", registry=MetricsRegistry())
+    assert pub.publish_once() is None
+
+
+# --- SLO grammar ----------------------------------------------------------
+
+
+def test_parse_targets_grammar():
+    targets = parse_targets(
+        "rate(tpfl_engine_rounds_total) >= 2.0; "
+        "gauge(tpfl_engine_idle_gap_seconds) <= 0.5;"
+        "ratio(tpfl_engine_wire_bytes_total, tpfl_engine_rounds_total) < 1e6"
+    )
+    assert [t.kind for t in targets] == ["rate", "gauge", "ratio"]
+    assert targets[2].metric_b == "tpfl_engine_rounds_total"
+    assert parse_targets("") == []
+    with pytest.raises(ValueError, match="unparseable SLO clause"):
+        parse_targets("rounds_per_sec >= 2")
+    with pytest.raises(ValueError, match="needs two metrics"):
+        parse_targets("ratio(tpfl_a_total) < 1")
+    with pytest.raises(ValueError, match="takes one metric"):
+        parse_targets("gauge(tpfl_a, tpfl_b) < 1")
+
+
+# --- the live watchdog ----------------------------------------------------
+
+
+def _drive(wd, reg, t, rate):
+    reg.counter("tpfl_engine_rounds_total", rate, labels={"model": "m"})
+    return wd.evaluate(now=t)
+
+
+def test_watchdog_catches_rate_regression_within_two_windows():
+    """The acceptance shape: a healthy A run stays silent; a ~20%
+    rounds/sec regression breaches within SLO_BREACH_WINDOWS
+    evaluations; the breach fires ONCE and re-arms after recovery."""
+    flight.clear("fleet-watchdog")
+    reg = MetricsRegistry()
+    wd = SLOWatchdog(
+        "rate(tpfl_engine_rounds_total) >= 2.4", registry=reg
+    )
+    t = 0.0
+    wd.evaluate(now=t)  # rate warms up: no signal on the first window
+    assert wd.verdicts()[0]["signal"] is None
+    for _ in range(4):  # healthy at 2.5/s
+        t += 1.0
+        _drive(wd, reg, t, 2.5)
+    assert wd.healthy()
+    breach_counter = (
+        "tpfl_slo_breach_total",
+        (("target", wd.verdicts()[0]["target"]),),
+    )
+    assert breach_counter not in metrics.fold()["counters"]
+    windows_to_breach = 0
+    while wd.healthy():  # inject the 20% regression: 2.0/s
+        t += 1.0
+        _drive(wd, reg, t, 2.0)
+        windows_to_breach += 1
+        assert windows_to_breach <= 10, "watchdog never fired"
+    # EWMA(0.3) from 2.5 crosses 2.4 on the first slow window; the
+    # streak fires on the second — within 2 windows of the signal
+    # going unhealthy, and ≤ a handful from injection.
+    assert windows_to_breach <= Settings.SLO_BREACH_WINDOWS + 1
+    events = [
+        e for e in flight.snapshot("fleet-watchdog")
+        if e.get("name") == "slo_breach"
+    ]
+    assert len(events) == 1
+    assert events[0]["threshold"] == 2.4
+    assert metrics.fold()["counters"][breach_counter] == 1.0
+    # Sustained breach: still ONE event.
+    t += 1.0
+    _drive(wd, reg, t, 2.0)
+    assert len(
+        [
+            e for e in flight.snapshot("fleet-watchdog")
+            if e.get("name") == "slo_breach"
+        ]
+    ) == 1
+    # Recovery re-arms; a fresh sustained breach fires a second event.
+    for _ in range(8):
+        t += 1.0
+        _drive(wd, reg, t, 3.5)
+    assert wd.healthy()
+    while wd.healthy():
+        t += 1.0
+        _drive(wd, reg, t, 1.0)
+    assert metrics.fold()["counters"][breach_counter] == 2.0
+
+
+def test_watchdog_gauge_and_ratio_signals():
+    reg = MetricsRegistry()
+    wd = SLOWatchdog(
+        "gauge(tpfl_engine_idle_gap_seconds) <= 0.5; "
+        "ratio(tpfl_engine_wire_bytes_total, tpfl_engine_rounds_total)"
+        " <= 100",
+        registry=reg,
+    )
+    reg.gauge("tpfl_engine_idle_gap_seconds", 0.1, labels={"driver": "p"})
+    reg.counter("tpfl_engine_rounds_total", 2)
+    reg.counter("tpfl_engine_wire_bytes_total", 100)
+    wd.evaluate(now=0.0)
+    g, r = wd.verdicts()
+    assert g["signal"] == 0.1 and g["healthy"]
+    assert r["signal"] is None  # ratio warms up like rate
+    reg.counter("tpfl_engine_rounds_total", 2)
+    reg.counter("tpfl_engine_wire_bytes_total", 120)
+    wd.evaluate(now=1.0)
+    r = wd.verdicts()[1]
+    assert r["signal"] == pytest.approx(60.0) and r["healthy"]
+    # A missing metric produces no signal and stays healthy (warm-up,
+    # not breach — a fresh process must not page anyone).
+    wd2 = SLOWatchdog("gauge(tpfl_never_emitted) <= 1", registry=reg)
+    wd2.evaluate(now=0.0)
+    assert wd2.healthy() and wd2.verdicts()[0]["signal"] is None
+
+
+def test_watchdog_uses_settings_targets(monkeypatch):
+    monkeypatch.setattr(
+        Settings, "SLO_TARGETS", "gauge(tpfl_engine_loss) <= 10"
+    )
+    wd = SLOWatchdog(registry=MetricsRegistry())
+    assert [t.kind for t in wd._targets] == ["gauge"]
+
+
+# --- HTTP endpoints -------------------------------------------------------
+
+
+def test_healthz_and_fleet_json_endpoints(tmp_path):
+    import urllib.error
+    import urllib.request
+
+    from tpfl.management.web_services import MetricsHTTPServer
+
+    reg = MetricsRegistry()
+    reg.gauge("tpfl_engine_idle_gap_seconds", 2.0)
+    wd = SLOWatchdog(
+        "gauge(tpfl_engine_idle_gap_seconds) <= 0.5", registry=reg
+    )
+    FleetPublisher(
+        "r0", directory=str(tmp_path), registry=_sample_registry()
+    ).publish_once()
+    srv = MetricsHTTPServer(
+        registry=reg, watchdog=wd, fleet_dir=str(tmp_path)
+    )
+    port = srv.start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=5
+        ) as resp:
+            doc = json.loads(resp.read())
+        assert doc["healthy"] and doc["targets"][0]["signal"] is None
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/fleet.json", timeout=5
+        ) as resp:
+            fleet = json.loads(resp.read())
+        assert (
+            fleet["counters"][
+                "tpfl_engine_rounds_total{model=m,origin=r0}"
+            ]
+            == 3.0
+        )
+        # Breach the target over SLO_BREACH_WINDOWS evaluations: the
+        # endpoint flips to 503 — the load balancer's signal.
+        for i in range(Settings.SLO_BREACH_WINDOWS + 1):
+            wd.evaluate(now=float(i))
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=5
+            )
+        assert err.value.code == 503
+        assert not json.loads(err.value.read())["healthy"]
+    finally:
+        srv.stop()
+
+
+def test_traceview_fleet_reads_live_endpoint():
+    from tools.traceview import fleet_view, load_metric_dumps
+
+    from tpfl.management.web_services import MetricsHTTPServer
+
+    reg = MetricsRegistry()
+    reg.counter("tpfl_engine_rounds_total", 5, labels={"model": "m"})
+    srv = MetricsHTTPServer(registry=reg)
+    port = srv.start()
+    try:
+        docs = load_metric_dumps([f"http://127.0.0.1:{port}/metrics.json"])
+        assert sorted(docs) == [f"127.0.0.1:{port}"]
+        view = fleet_view(docs)
+        key = (
+            "tpfl_engine_rounds_total"
+            f"{{model=m,origin=127.0.0.1:{port}}}"
+        )
+        assert view["counters"][key] == 5.0
+    finally:
+        srv.stop()
+
+
+# --- population observatory fan-out + traceview join ----------------------
+
+
+def test_population_round_fanout_and_traceview_join():
+    from tools.traceview import build_timeline, population_report, \
+        render_population
+
+    flight.clear("population")
+    fleetobs.population_round(
+        "population",
+        round=3, census=1000, sampled=10, folded=7, cut=3, touched=42,
+        coverage=0.05, fairness=0.9, staleness=[0.0, 1.0, 4.0],
+    )
+    folded = metrics.fold()
+    labels = (("node", "population"),)
+    assert folded["gauges"][("tpfl_pop_coverage", labels)] == 0.05
+    assert folded["gauges"][("tpfl_pop_cutoff_frac", labels)] == 0.3
+    hist = folded["histograms"][("tpfl_pop_staleness", labels)]
+    assert hist[-1] >= 3
+    events = [
+        dict(e) for e in flight.snapshot("population")
+        if e.get("name") == "population_round"
+    ]
+    assert events and events[-1]["fairness"] == 0.9
+    # The quarantine join: a same-round verdict lands on the row.
+    events.append(
+        {
+            "kind": "event", "name": "quarantine", "node": "a",
+            "trace": "", "t": 1.0, "peer": "evil", "round": 3,
+        }
+    )
+    rows = population_report(build_timeline(events))
+    assert rows[-1]["round"] == 3
+    assert rows[-1]["actions"] == ["quarantine:evil"]
+    text = render_population(build_timeline(events))
+    assert "quarantine:evil" in text and "0.0500" in text
+    assert "no population_round events" in render_population({})
+
+
+def test_complete_round_emits_population_series():
+    from tpfl.parallel.population import ClientPopulation
+
+    flight.clear("population")
+    pop = ClientPopulation(registered=512, sample=8, seed=3)
+    ids = pop.begin_round()
+    w = pop.round_weights(ids, cutoff_frac=0.25)
+    pop.complete_round(ids, weights=w)
+    folded = metrics.fold()
+    labels = (("node", "population"),)
+    assert folded["gauges"][("tpfl_pop_census", labels)] == 512.0
+    assert folded["gauges"][("tpfl_pop_coverage", labels)] == pytest.approx(
+        8 / 512
+    )
+    events = [
+        e for e in flight.snapshot("population")
+        if e.get("name") == "population_round"
+    ]
+    assert events[-1]["sampled"] == 8
+    assert events[-1]["cut"] == int((w <= 0).sum())
+
+
+# --- NodeMonitor's fleet sample ------------------------------------------
+
+
+def test_emit_fleet_gauges_from_registered_views():
+    class FakeView:
+        capacity = 8
+
+        def live(self):
+            return 5
+
+        def quarantined(self):
+            return {"bad-node"}
+
+    class FakePop:
+        registered = 1000
+        touched = 17
+
+    view, pop = FakeView(), FakePop()
+    with fleetobs._meta_lock:  # isolate from earlier tests' engines
+        fleetobs._views.clear()
+        fleetobs._populations.clear()
+    fleetobs.register_view(view)
+    fleetobs.register_population(pop)
+    fleetobs.emit_fleet_gauges("mon-node")
+    folded = metrics.fold()
+    labels = (("node", "mon-node"),)
+    assert folded["gauges"][("tpfl_membership_capacity", labels)] == 8.0
+    assert folded["gauges"][("tpfl_membership_live", labels)] == 5.0
+    assert folded["gauges"][("tpfl_membership_quarantined", labels)] == 1.0
+    assert folded["gauges"][("tpfl_membership_fill", labels)] == 5 / 8
+    assert folded["gauges"][("tpfl_pop_census", labels)] == 1000.0
+    assert folded["gauges"][("tpfl_pop_touched", labels)] == 17.0
+    # Weak registration: a dead view drops out, the emit never raises.
+    del view, pop
+    fleetobs.emit_fleet_gauges("mon-node")
+
+
+def test_emit_fleet_gauges_reads_real_membership_view():
+    # The REAL MembershipView exposes `live` as a PROPERTY (the fakes
+    # above use a callable) — the emitter must read both shapes, and
+    # a silent per-view except/continue must never hide the mismatch.
+    from tpfl.parallel.membership import MembershipView
+
+    view = MembershipView([f"n{i}" for i in range(5)])
+    view.quarantine("n4")
+    with fleetobs._meta_lock:  # isolate from earlier tests' engines
+        fleetobs._views.clear()
+        fleetobs._populations.clear()
+    fleetobs.register_view(view)
+    fleetobs.emit_fleet_gauges("mon-real")
+    folded = metrics.fold()
+    labels = (("node", "mon-real"),)
+    assert folded["gauges"][
+        ("tpfl_membership_capacity", labels)
+    ] == float(view.capacity)
+    assert folded["gauges"][("tpfl_membership_live", labels)] == 5.0
+    assert folded["gauges"][("tpfl_membership_quarantined", labels)] == 1.0
+
+
+def test_node_monitor_sample_emits_fleet_gauges():
+    from tpfl.management.node_monitor import NodeMonitor
+
+    class FakeView:
+        capacity = 16
+
+        def live(self):
+            return 9
+
+        def quarantined(self):
+            return set()
+
+    view = FakeView()
+    with fleetobs._meta_lock:  # isolate from earlier tests' engines
+        fleetobs._views.clear()
+        fleetobs._populations.clear()
+    fleetobs.register_view(view)
+    mon = NodeMonitor("mon-sample")  # never started: one direct sample
+    mon._sample()
+    folded = metrics.fold()
+    labels = (("node", "mon-sample"),)
+    assert folded["gauges"][("tpfl_membership_capacity", labels)] == 16.0
+    assert folded["gauges"][("tpfl_membership_live", labels)] == 9.0
+    # The system plane still samples alongside the fleet plane.
+    assert ("tpfl_system_cpu_percent", labels) in folded["gauges"]
+
+
+def test_engine_attach_registers_with_fleetobs():
+    from tpfl.models import MLP
+    from tpfl.parallel.engine import FederationEngine
+    from tpfl.parallel.membership import MembershipView
+    from tpfl.parallel.population import ClientPopulation
+
+    eng = FederationEngine(MLP(hidden_sizes=(4,)), 4, seed=0)
+    view = MembershipView([f"n{i}" for i in range(4)])
+    eng.attach_membership(view)
+    pop = ClientPopulation(registered=64, sample=4, seed=0)
+    eng.attach_population(pop)
+    with fleetobs._meta_lock:
+        assert view in fleetobs._views
+        assert pop in fleetobs._populations

@@ -182,3 +182,86 @@ def test_population_state_o_active_rss():
     # be tens-to-hundreds of MB; the whole 10x sweep must cost < 64 MB
     # of peak growth.
     assert (rss1 - rss0) / 1024.0 < 64.0
+    # The observatory's one O(census) concession is the coverage
+    # BITSET — exactly one bit per registered client, nothing more.
+    assert big._coverage.nbytes == (1_000_000 + 7) // 8
+
+
+# --- (d) population observatory sketches (ISSUE 20) ------------------------
+
+
+def test_population_coverage_and_fairness_sketches():
+    pop = ClientPopulation(registered=64, sample=4, seed=9)
+    seen: set = set()
+    for _ in range(5):
+        ids = pop.begin_round()
+        seen.update(int(i) for i in ids)
+        pop.complete_round(ids)
+    # Coverage counts distinct EVER-reached clients, duplicates free.
+    assert pop.coverage == pytest.approx(len(seen) / 64)
+    # Fairness is Jain's index over the touched clients' fold counts.
+    counts = [rec["rounds"] for rec in pop.clients.values()]
+    jain = sum(counts) ** 2 / (len(counts) * sum(c * c for c in counts))
+    assert pop.fairness == pytest.approx(jain)
+    assert 0.0 < pop.fairness <= 1.0
+
+
+def test_population_cut_clients_count_for_coverage_not_fairness():
+    pop = ClientPopulation(registered=32, sample=8, seed=1)
+    ids = pop.begin_round()
+    w = np.ones(8, np.float32)
+    w[:3] = 0.0  # three stragglers cut
+    pop.complete_round(ids, w)
+    # The sampler REACHED all 8 (coverage), only 5 folded (touched).
+    assert pop.coverage == pytest.approx(8 / 32)
+    assert pop.touched == 5
+    assert pop.fairness == 1.0  # every folder folded exactly once
+
+
+def test_population_staleness_gap_semantics():
+    from tpfl.management.telemetry import metrics
+
+    pop = ClientPopulation(registered=16, sample=2, seed=0)
+    ids = pop.begin_round()
+    pop.complete_round(ids)  # round 0: both first-timers -> gap 0
+    pop.round = 5
+    pop.complete_round(ids)  # round 5: gap = 5 - 0 = 5 for both
+    hist = metrics.fold()["histograms"][
+        ("tpfl_pop_staleness", (("node", "population"),))
+    ]
+    # Bucket edges (...4.0, 8.0...): the two gap-5 observations land
+    # at or above the 8.0-edge cumulative position; exact placement is
+    # telemetry's concern — here we pin sum bookkeeping.
+    assert hist[-2] >= 10.0  # two gaps of 5 contributed to the sum
+
+
+def test_population_sketch_state_roundtrip():
+    pop = ClientPopulation(registered=1000, sample=16, seed=4)
+    for _ in range(4):
+        ids = pop.begin_round()
+        w = pop.round_weights(ids, cutoff_frac=0.25)
+        pop.complete_round(ids, w)
+    state = pop.state_export()
+    # Raw bytes, one bit per registered client.
+    assert isinstance(state["coverage"], bytes)
+    assert len(state["coverage"]) == (1000 + 7) // 8
+    twin = ClientPopulation.from_state(state)
+    assert twin.coverage == pop.coverage
+    assert twin.fairness == pytest.approx(pop.fairness)
+    assert twin._sampled_count == pop._sampled_count
+    np.testing.assert_array_equal(twin._coverage, pop._coverage)
+
+
+def test_population_legacy_checkpoint_rebuilds_coverage():
+    pop = ClientPopulation(registered=256, sample=8, seed=6)
+    ids = pop.begin_round()
+    w = pop.round_weights(ids, cutoff_frac=0.25)
+    pop.complete_round(ids, w)
+    state = pop.state_export()
+    del state["coverage"]  # pre-ISSUE-20 checkpoint shape
+    old = ClientPopulation.from_state(state)
+    # Folded clients rebuild their bits; cut-only clients are lost —
+    # coverage restores as a LOWER BOUND, never an overcount.
+    assert old._sampled_count == old.touched
+    assert old._sampled_count <= pop._sampled_count
+    assert old.fairness == pytest.approx(pop.fairness)

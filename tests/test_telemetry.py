@@ -364,6 +364,75 @@ def test_registry_merge_sums_and_labels():
         MetricsRegistry.merge(a, b, names=["only-one"])
 
 
+def test_registry_merge_histogram_bucket_mismatch_keeps_first():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.observe("t_m_edges", 1.5, buckets=(1.0, 2.0, 4.0))
+    b.observe("t_m_edges", 1.5, buckets=(1.0, 8.0))  # incompatible edges
+    merged = MetricsRegistry.merge(a, b, names=["n0", "n1"])
+    folded = merged.fold()
+    # n0's labeled series survives intact; n1's mismatched one is the
+    # conflict loser — dropped, never summed into corrupt buckets.
+    first = folded["histograms"][("t_m_edges", (("origin", "n0"),))]
+    assert first[-1] == 1 and first[-2] == 1.5
+    # Same edges from a third registry DO fold into n0's series when
+    # the merge is unlabeled (that's the same-series sum path).
+    c = MetricsRegistry()
+    c.observe("t_m_edges", 2.5, buckets=(1.0, 2.0, 4.0))
+    folded = MetricsRegistry.merge(a, c).fold()
+    assert folded["histograms"][("t_m_edges", ())][-1] == 2
+
+
+def test_registry_merge_under_concurrent_shard_updates():
+    """merge() folds registries other threads are actively writing:
+    per-shard locking means the merged totals land between the
+    written-so-far floor and the final total, and the writers' own
+    post-join fold is exact."""
+    regs = [MetricsRegistry() for _ in range(3)]
+    n_incr = 400
+    stop = threading.Event()
+    errors: list[BaseException] = []
+
+    def hammer(reg: MetricsRegistry) -> None:
+        try:
+            for i in range(n_incr):
+                reg.counter("t_m_conc_total", 1, labels={"k": "v"})
+                reg.gauge("t_m_conc_gauge", float(i))
+                reg.observe("t_m_conc_hist", float(i % 5))
+        except BaseException as e:  # pragma: no cover - failure path
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=hammer, args=(r,), daemon=True)
+        for r in regs
+        for _ in range(2)  # two writer threads per registry
+    ]
+    for t in threads:
+        t.start()
+    # Merge repeatedly WHILE the writers run — must never raise, and
+    # every observed total must be a plausible mid-flight value.
+    key = ("t_m_conc_total", (("k", "v"),))
+    try:
+        while any(t.is_alive() for t in threads):
+            folded = MetricsRegistry.merge(*regs).fold()
+            total = folded["counters"].get(key, 0.0)
+            assert 0.0 <= total <= 6 * n_incr
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+    assert not errors
+    folded = MetricsRegistry.merge(*regs, names=["a", "b", "c"]).fold()
+    for name in ("a", "b", "c"):
+        per = folded["counters"][
+            ("t_m_conc_total", (("k", "v"), ("origin", name)))
+        ]
+        assert per == 2 * n_incr
+        hist = folded["histograms"][
+            ("t_m_conc_hist", (("origin", name),))
+        ]
+        assert hist[-1] == 2 * n_incr
+
+
 def test_traceview_fleet_view(tmp_path):
     for name, val in (("alpha", 1.0), ("beta", 2.0)):
         reg = MetricsRegistry()

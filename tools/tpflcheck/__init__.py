@@ -1,7 +1,7 @@
 """tpflcheck — tpfl's static concurrency & invariant analysis suite.
 
 One framework: shared file-walking / waiver / reporting machinery
-(``core.py``), fourteen checks::
+(``core.py``), fifteen checks::
 
     guards    guarded-by race lint (# guarded-by: annotations)
     locks     static lock-order extraction + deadlock (cycle) detection
@@ -29,6 +29,9 @@ One framework: shared file-walking / waiver / reporting machinery
     events    event-name drift lint (every flight span/event name
               emitted in tpfl/ must appear in docs/observability.md's
               taxonomy tables — waivable)
+    metrics   metric-name drift lint (every tpfl_* series name a
+              counter/gauge/observe call registers must appear in
+              docs/observability.md's series tables — waivable)
     wire      codec-registry, copy-discipline and RPC-path lints
               (the original wirecheck trio)
     state     checkpoint-state totality (every mutable field of the
@@ -66,6 +69,7 @@ from tools.tpflcheck.guards import check_guards
 from tools.tpflcheck.knobs import check_knobs
 from tools.tpflcheck.layers import check_layers
 from tools.tpflcheck.locks import check_locks, lock_edges
+from tools.tpflcheck.metrics import check_metrics
 from tools.tpflcheck.rank import check_rank
 from tools.tpflcheck.spmd import check_spmd
 from tools.tpflcheck.state import check_state
@@ -83,6 +87,7 @@ __all__ = [
     "check_knobs",
     "check_layers",
     "check_locks",
+    "check_metrics",
     "check_rank",
     "check_spmd",
     "check_state",
@@ -110,6 +115,7 @@ def run_all(
     violations += check_threads(root)
     violations += check_trace(root)
     violations += check_events(root)
+    violations += check_metrics(root)
     violations += check_donate(root)
     violations += check_capture(root)
     violations += check_spmd(root)

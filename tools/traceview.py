@@ -26,7 +26,16 @@ Run::
 documents (``metrics-<node>.json``) and renders ONE labeled-by-node
 Prometheus/JSON view of the whole simulation's registries
 (:func:`fleet_view` / :func:`render_fleet`; the in-process equivalent
-is ``MetricsRegistry.merge``) — today each node scrapes in isolation.
+is ``MetricsRegistry.merge``). Paths may also be live ``http(s)://``
+endpoints — ``MetricsHTTPServer``'s ``/metrics.json`` (one process) or
+rank 0's ``/fleet.json`` (the already-merged cross-host fold) — so the
+same command works against a RUNNING federation.
+
+``--population`` is the cross-device cohort view: each
+``population_round`` flight event (``ClientPopulation.complete_round``'s
+per-round sketch — census coverage, participation fairness, straggler
+cutoff) joined with the quarantine engine's ``quarantine`` / ``readmit``
+verdicts for that round (:func:`population_report`).
 
 ``--ledger`` joins the learning-plane ledger's ``contrib`` / ``anomaly``
 events (``tpfl.management.ledger``, recorded into the same flight rings
@@ -254,10 +263,24 @@ def render_ledger(timeline: dict[str, list[dict]]) -> str:
 def load_metric_dumps(paths: Iterable[str]) -> dict[str, dict]:
     """Load per-node ``MetricsRegistry.dump_json`` documents for the
     fleet view: files (or directories of ``metrics-*.json``) keyed by
-    node name — the ``metrics-`` / ``.json`` trimmed file stem."""
+    node name — the ``metrics-`` / ``.json`` trimmed file stem.
+
+    ``http(s)://`` paths scrape a LIVE endpoint instead
+    (``MetricsHTTPServer`` — ``/metrics.json`` for one process,
+    ``/fleet.json`` for rank 0's already-merged cross-host view), so
+    ``--fleet`` works against a running federation, not just its
+    post-mortem dumps. Live documents key by host:port."""
     docs: dict[str, dict] = {}
     files: list[pathlib.Path] = []
     for p in paths:
+        if str(p).startswith(("http://", "https://")):
+            import urllib.parse
+            import urllib.request
+
+            with urllib.request.urlopen(str(p), timeout=10) as resp:
+                doc = json.loads(resp.read().decode("utf-8"))
+            docs[urllib.parse.urlparse(str(p)).netloc or str(p)] = doc
+            continue
         path = pathlib.Path(p)
         if path.is_dir():
             files.extend(sorted(path.glob("metrics-*.json")))
@@ -319,6 +342,69 @@ def render_fleet(view: dict[str, Any]) -> str:
         lines.append(f"{name}_sum{labels} {h.get('sum', 0):g}")
         lines.append(f"{name}_count{labels} {h.get('count', 0)}")
     return "\n".join(lines) + "\n"
+
+
+def population_report(timeline: dict[str, list[dict]]) -> list[dict]:
+    """Cohort health per population round, joined with the defense
+    plane: every ``population_round`` flight event (the cross-device
+    observatory's per-round sketch — census/coverage/fairness/
+    stragglers, recorded by ``ClientPopulation.complete_round``)
+    becomes one row, and any ``quarantine`` / ``readmit`` actions the
+    quarantine engine took in the same round merge into it — "how
+    healthy was this round's cohort, and what did the defense do about
+    it" on one line."""
+    rounds: dict[int, dict] = {}
+    actions: dict[int, list[str]] = {}
+    for chain in timeline.values():
+        for e in chain:
+            name = e.get("name")
+            if name == "population_round":
+                r = int(e.get("round", -1))
+                rounds[r] = {
+                    "round": r,
+                    "census": int(e.get("census", 0)),
+                    "sampled": int(e.get("sampled", 0)),
+                    "folded": int(e.get("folded", 0)),
+                    "cut": int(e.get("cut", 0)),
+                    "touched": int(e.get("touched", 0)),
+                    "coverage": float(e.get("coverage", 0.0)),
+                    "fairness": float(e.get("fairness", 0.0)),
+                    "actions": [],
+                }
+            elif name in ("quarantine", "readmit"):
+                r = int(e.get("round", -1))
+                actions.setdefault(r, []).append(
+                    f"{name}:{e.get('peer', '?')}"
+                )
+    for r, acts in actions.items():
+        if r in rounds:
+            rounds[r]["actions"] = sorted(acts)
+    return [rounds[r] for r in sorted(rounds)]
+
+
+def render_population(timeline: dict[str, list[dict]]) -> str:
+    rows = population_report(timeline)
+    if not rows:
+        return (
+            "no population_round events (is a ClientPopulation "
+            "attached and completing rounds?)"
+        )
+    lines = [
+        f"{len(rows)} population rounds "
+        f"(census {rows[-1]['census']}, "
+        f"coverage {rows[-1]['coverage']:.4f}, "
+        f"touched {rows[-1]['touched']})",
+        f"{'rnd':>4} {'sampled':>7} {'folded':>6} {'cut':>4} "
+        f"{'touched':>7} {'coverage':>8} {'fairness':>8}  defense",
+    ]
+    for r in rows:
+        acts = ", ".join(r["actions"]) if r["actions"] else "-"
+        lines.append(
+            f"{r['round']:>4} {r['sampled']:>7} {r['folded']:>6} "
+            f"{r['cut']:>4} {r['touched']:>7} {r['coverage']:>8.4f} "
+            f"{r['fairness']:>8.4f}  {acts}"
+        )
+    return "\n".join(lines)
 
 
 def summarize(timeline: dict[str, list[dict]]) -> dict[str, Any]:
@@ -388,6 +474,12 @@ def main(argv: "list[str] | None" = None) -> int:
         "Prometheus text (--summary: the merged JSON document)",
     )
     ap.add_argument(
+        "--population", action="store_true",
+        help="population-plane view: per-round cohort health "
+        "(coverage/fairness/stragglers from population_round events) "
+        "joined with quarantine/readmit verdicts",
+    )
+    ap.add_argument(
         "--limit", type=int, default=20,
         help="max traces to render (0 = all)",
     )
@@ -400,7 +492,9 @@ def main(argv: "list[str] | None" = None) -> int:
             print(render_fleet(view), end="")
         return 0
     timeline = build_timeline(load(args.paths))
-    if args.ledger:
+    if args.population:
+        print(render_population(timeline))
+    elif args.ledger:
         print(render_ledger(timeline))
     elif args.summary:
         print(json.dumps(summarize(timeline), indent=2))

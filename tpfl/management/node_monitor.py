@@ -80,6 +80,7 @@ class NodeMonitor(threading.Thread):
         self._emit("net_out_bytes_per_s", (net.bytes_sent - last_sent) / dt)
         self._sample_tpu()
         self._sample_ledger()
+        self._sample_fleet()
 
     def _sample_tpu(self) -> None:
         """TPU-native extension: HBM usage per local device, routed
@@ -110,5 +111,20 @@ class NodeMonitor(threading.Thread):
             stats = ledger.contrib.stats_for(self._node)
             self._emit("ledger_entries", float(stats["entries"]))
             self._emit("ledger_flagged", float(stats["flagged"]))
+        except Exception:
+            pass
+
+    def _sample_fleet(self) -> None:
+        """Fleet-plane extension (ISSUE-20): membership-tier occupancy
+        (capacity/live/quarantined/fill) and population census/touched
+        gauges for every view/population weakly registered with
+        :mod:`tpfl.management.fleetobs` — the previously-invisible
+        elastic-tier and cross-device state, sampled on the same
+        dashboard cadence. Host-side attribute reads only; the weak
+        registry means a dead engine simply drops out."""
+        try:
+            from tpfl.management import fleetobs
+
+            fleetobs.emit_fleet_gauges(self._node)
         except Exception:
             pass

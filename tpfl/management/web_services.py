@@ -11,6 +11,13 @@ HTTP server exposing the process metrics registry
 (:mod:`tpfl.management.telemetry`) as Prometheus text at ``/metrics``
 and as JSON at ``/metrics.json`` — point a scraper at any simulation
 host and every node's counters/gauges/histograms are one GET away.
+ISSUE-20 adds the fleet plane: ``/fleet.json`` serves the MERGED
+cross-rank view (every published ``fleetsnap-*.json`` in
+``Settings.FLEETOBS_DIR`` folded through
+:func:`tpfl.management.fleetobs.fleet_from_dir`, ``origin=<rank>``
+labels intact) and ``/healthz`` answers 200/503 from the attached
+:class:`~tpfl.management.fleetobs.SLOWatchdog`'s verdicts — the load
+balancer's view of a federation's declared SLOs.
 """
 
 from __future__ import annotations
@@ -108,30 +115,58 @@ class MetricsHTTPServer:
     simulated node."""
 
     def __init__(
-        self, port: int = 0, registry: "telemetry.MetricsRegistry | None" = None
+        self,
+        port: int = 0,
+        registry: "telemetry.MetricsRegistry | None" = None,
+        watchdog: "Any | None" = None,
+        fleet_dir: "str | None" = None,
     ) -> None:
         self._registry = registry if registry is not None else telemetry.metrics
         self._port = port
+        self._watchdog = watchdog
+        self._fleet_dir = fleet_dir
         self._httpd: Optional[HTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self.port: int = 0
 
     def start(self) -> int:
         registry = self._registry
+        watchdog = self._watchdog
+        fleet_dir = self._fleet_dir
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802 (stdlib API name)
+                status = 200
                 if self.path.startswith("/metrics.json"):
                     body = registry.dump_json().encode()
                     ctype = "application/json"
                 elif self.path.startswith("/metrics"):
                     body = registry.render_prometheus().encode()
                     ctype = "text/plain; version=0.0.4"
+                elif self.path.startswith("/fleet.json"):
+                    # Fold at GET time: the fleet view is always as
+                    # fresh as the last published snapshots.
+                    from tpfl.management import fleetobs
+
+                    body = fleetobs.fleet_from_dir(fleet_dir).dump_json(
+                    ).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/healthz"):
+                    verdicts = (
+                        watchdog.verdicts() if watchdog is not None else []
+                    )
+                    healthy = watchdog.healthy() if watchdog else True
+                    status = 200 if healthy else 503
+                    body = json.dumps(
+                        {"healthy": healthy, "targets": verdicts},
+                        sort_keys=True,
+                    ).encode()
+                    ctype = "application/json"
                 else:
                     self.send_response(404)
                     self.end_headers()
                     return
-                self.send_response(200)
+                self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()

@@ -171,6 +171,41 @@ def demo_run(
     # the dense/quant8 ratio on this).
     from tpfl.learning import compression
 
+    # The fleet-observatory leg (ISSUE-20): every worker receipt
+    # embeds a one-shot snapshot of its process registry, restricted
+    # to the deterministic series (tpfl_engine_* / tpfl_pop_* /
+    # tpfl_slo_*) so rank-0's fold — fleetobs.fold_receipts — renders
+    # byte-identically across same-seed runs. origin = the jax
+    # process index, the label the merged view keys per-rank series
+    # by. The cross-host window's telemetry rows are globally sharded
+    # (engine_obs.replay_window skips them — the observatory fan-out
+    # is a single-host plane), so under ENGINE_TELEMETRY each worker
+    # emits its per-rank engine series HERE, as pure functions of the
+    # deterministic run outputs.
+    from tpfl.management import fleetobs
+    from tpfl.management.telemetry import metrics
+    from tpfl.settings import Settings
+
+    if Settings.ENGINE_TELEMETRY:
+        rank_labels = {"node": f"rank{jax.process_index()}"}
+        metrics.counter(
+            "tpfl_engine_rounds_total", float(rounds), labels=rank_labels
+        )
+        metrics.gauge(
+            "tpfl_engine_loss",
+            float(np.mean(fetch(losses)[:nodes])),
+            labels=rank_labels,
+        )
+        metrics.gauge(
+            "tpfl_engine_model_norm",
+            float(np.linalg.norm(global_row)),
+            labels=rank_labels,
+        )
+    metrics_snapshot = fleetobs.snapshot(
+        origin=str(jax.process_index()),
+        prefixes=fleetobs.DETERMINISTIC_PREFIXES,
+    )
+
     hosts = mesh_axis_size(mesh, HOST_AXIS) if mesh is not None else 1
     dcn_bytes = 0
     if hosts > 1:
@@ -189,6 +224,7 @@ def demo_run(
         # Settings.RANK_CONTRACTS armed the engine's recording.
         "program_digests": ranksafe.receipt(),
         "dcn_bytes_per_round": int(dcn_bytes),
+        "metrics_snapshot": metrics_snapshot,
         "global": global_row.tolist(),
         "losses": fetch(losses)[:nodes].astype(np.float64).tolist(),
         "digest": digest,

@@ -890,6 +890,11 @@ class FederationEngine:
         weights from ``view.weights()`` — joins, leaves, crashes and
         quarantine verdicts become pure mask edits."""
         self.membership = view
+        # Fleet plane: weakly registered so NodeMonitor's fleet sample
+        # can gauge tier occupancy without touching the engine.
+        from tpfl.management import fleetobs
+
+        fleetobs.register_view(view)
         if int(view.capacity) != self.n_nodes:
             self.resize_nodes(int(view.capacity))
 
@@ -909,6 +914,9 @@ class FederationEngine:
         self.population = population
         if population is not None:
             population.bind(self)
+            from tpfl.management import fleetobs
+
+            fleetobs.register_population(population)
 
     def sync_membership(self) -> bool:
         """Re-align the node axis with the attached view's tier (after

@@ -42,6 +42,8 @@ from typing import Any, Optional
 
 import numpy as np
 
+from tpfl.learning.serialization import leaf_bytes
+from tpfl.management import fleetobs
 from tpfl.parallel.engine import FedBuffSchedule, sample_participants
 from tpfl.settings import Settings
 
@@ -90,6 +92,20 @@ class ClientPopulation:
         # client has folded. int keys in memory; stringified for the
         # msgpack checkpoint (state_export).
         self.clients: dict[int, dict] = {}
+        # The ONE allowed O(census) structure (ISSUE-20): a coverage
+        # BITSET — one bit per registered client, set the first time
+        # the sampler reaches it. 1M census = 125 KB; everything else
+        # in the observatory stays O(1)/O(touched).
+        self._coverage = np.zeros((self.registered + 7) // 8, np.uint8)
+        # ephemeral: derived sketch — the coverage bitset's popcount,
+        # recomputed exactly from the exported bitset on import.
+        self._sampled_count = 0
+        # ephemeral: derived sketch — Jain-fairness Σ rounds over
+        # touched clients, recomputed from the clients dict on import.
+        self._part_sum = 0
+        # ephemeral: derived sketch — Jain-fairness Σ rounds² over
+        # touched clients, recomputed from the clients dict on import.
+        self._part_sumsq = 0
         # ephemeral: runtime binding — re-established by bind() when
         # the restored population re-attaches (import_state calls it).
         self._engine: Optional[Any] = None
@@ -203,29 +219,93 @@ class ClientPopulation:
         clients' records (stragglers — w=0 rows — do not advance:
         their contribution never folded). ``losses`` (optional,
         positionally aligned with ``ids``) lands in each record as
-        the client's last observed loss."""
-        ids = np.asarray(ids)
+        the client's last observed loss.
+
+        The commit walk doubles as the population observatory's
+        sampling point (ISSUE-20): every sampled id — cut or not —
+        sets its coverage bit (the sampler REACHED it), each folding
+        client's staleness gap (rounds since it last folded, 0 for a
+        first participation) is captured before its record advances,
+        and the Jain-fairness partial sums track the fold-count bump
+        in O(1). The round's sketch then fans out through
+        :func:`tpfl.management.fleetobs.population_round` as
+        ``tpfl_pop_*`` series + one ``population_round`` flight event
+        — all O(touched) work the walk was already paying for."""
+        ids = np.asarray(ids, np.int64)
         w = (
             np.ones((ids.shape[0],), np.float32)
             if weights is None
             else np.asarray(weights, np.float32)
         )
+        # Coverage: vectorized bitset update. Sampled ids are distinct
+        # (sample without replacement) so distinct (byte, bit) pairs —
+        # the pre-update gather counts newly-reached clients exactly;
+        # bitwise_or.at accumulates correctly when ids share a byte.
+        if ids.size:
+            byte_idx = ids >> 3
+            bit = (np.uint8(1) << (ids & 7).astype(np.uint8))
+            old = self._coverage[byte_idx]
+            self._sampled_count += int(np.count_nonzero((old & bit) == 0))
+            np.bitwise_or.at(self._coverage, byte_idx, bit)
+        staleness: list[float] = []
+        folded = 0
         for pos, cid in enumerate(ids):
             if w[pos] <= 0:
                 continue
+            folded += 1
             rec = self.clients.setdefault(
                 int(cid), {"rounds": 0, "last_round": -1, "loss": 0.0}
             )
-            rec["rounds"] = int(rec["rounds"]) + 1
+            prior = int(rec["rounds"])
+            staleness.append(
+                float(self.round - int(rec["last_round"])) if prior else 0.0
+            )
+            # Fairness partial sums: rounds c -> c+1 moves Σc by 1 and
+            # Σc² by 2c+1 — Jain's index stays an O(1) read.
+            self._part_sum += 1
+            self._part_sumsq += 2 * prior + 1
+            rec["rounds"] = prior + 1
             rec["last_round"] = int(self.round)
             if losses is not None:
                 rec["loss"] = float(np.asarray(losses)[pos])
+        committed = int(self.round)
         self.round += 1
+        fleetobs.population_round(
+            "population",
+            round=committed,
+            census=self.registered,
+            sampled=int(ids.shape[0]),
+            folded=folded,
+            cut=int(ids.shape[0]) - folded,
+            touched=len(self.clients),
+            coverage=self.coverage,
+            fairness=self.fairness,
+            staleness=staleness,
+        )
 
     @property
     def touched(self) -> int:
         """Clients that have ever folded — the snapshot's size."""
         return len(self.clients)
+
+    @property
+    def coverage(self) -> float:
+        """Fraction of the census the sampler has EVER reached (the
+        coverage bitset's popcount over ``registered``) — cut clients
+        count: they were drawn, only their fold was dropped."""
+        return self._sampled_count / float(self.registered)
+
+    @property
+    def fairness(self) -> float:
+        """Jain's index over touched clients' participation counts:
+        ``(Σc)² / (touched · Σc²)`` — 1.0 is perfectly even service,
+        →1/touched is one client hoarding every fold. 1.0 for an
+        untouched census (no service yet = no unfairness yet)."""
+        if not self.clients or self._part_sumsq == 0:
+            return 1.0
+        return (self._part_sum * self._part_sum) / (
+            len(self.clients) * float(self._part_sumsq)
+        )
 
     # --- checkpoint state -------------------------------------------------
 
@@ -237,6 +317,10 @@ class ClientPopulation:
             "sample": int(self.sample),
             "seed": int(self.seed),
             "round": int(self.round),
+            # The coverage bitset rides as raw bytes (msgpack bin,
+            # 125 KB at a 1M census) — bytes, not ndarray, so the
+            # snapshot dict stays ==-comparable for contract checks.
+            "coverage": bytes(leaf_bytes(self._coverage)),
             "clients": {
                 str(cid): {
                     "rounds": int(rec["rounds"]),
@@ -260,6 +344,31 @@ class ClientPopulation:
             }
             for cid, rec in dict(state.get("clients", {})).items()
         }
+        n_bytes = (self.registered + 7) // 8
+        cov = state.get("coverage")
+        if cov is not None:
+            self._coverage = np.zeros(n_bytes, np.uint8)
+            arr = (
+                np.frombuffer(cov, np.uint8)
+                if isinstance(cov, (bytes, bytearray))
+                else np.asarray(cov, np.uint8).ravel()
+            )
+            self._coverage[: min(arr.size, n_bytes)] = arr[:n_bytes]
+        else:
+            # Pre-ISSUE-20 checkpoint: best-effort rebuild — folded
+            # clients were certainly sampled; cut-only clients are
+            # unrecoverable, so coverage restores as a lower bound.
+            self._coverage = np.zeros(n_bytes, np.uint8)
+            for cid in self.clients:
+                self._coverage[cid >> 3] |= np.uint8(1 << (cid & 7))
+        # Derived sketches recompute exactly from the restored state.
+        self._sampled_count = int(np.unpackbits(self._coverage).sum())
+        self._part_sum = sum(
+            int(rec["rounds"]) for rec in self.clients.values()
+        )
+        self._part_sumsq = sum(
+            int(rec["rounds"]) ** 2 for rec in self.clients.values()
+        )
 
     @classmethod
     def from_state(cls, state: dict) -> "ClientPopulation":

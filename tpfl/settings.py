@@ -442,6 +442,55 @@ class Settings:
     unbounded per-step series on a long-running node was the only
     unbounded memory left in the management layer."""
 
+    FLEETOBS_SNAPSHOT_PERIOD: float = 0.0
+    """Cadence (s) of the fleet-observatory snapshot publisher
+    (tpfl.management.fleetobs.FleetPublisher): every period the
+    process' MetricsRegistry is folded and written atomically as
+    ``fleetsnap-<origin>.json`` into ``FLEETOBS_DIR``, where rank 0
+    (or any scraper) folds all ranks' snapshots into ONE fleet
+    registry (``MetricsRegistry.merge`` semantics, ``origin=<rank>``
+    labels) served by ``MetricsHTTPServer`` ``/fleet.json``. 0.0
+    (default) = no publisher thread; the crosshost receipt path still
+    embeds a one-shot snapshot per worker regardless (that path is
+    pull-per-run, not periodic)."""
+
+    FLEETOBS_DIR: str = ""
+    """Directory the fleet snapshot publisher writes to and the fleet
+    fold reads from (one ``fleetsnap-<origin>.json`` per process,
+    written tmp+rename so readers never see a torn document). Empty
+    (default) disables file publishing even when
+    ``FLEETOBS_SNAPSHOT_PERIOD`` is set — multi-host deployments point
+    every rank at one shared path (NFS/GCS-fuse), single-host
+    simulations at any tmp dir."""
+
+    SLO_TARGETS: str = ""
+    """Declared service-level objectives the live watchdog
+    (tpfl.management.fleetobs.SLOWatchdog) evaluates over the metrics
+    registry: semicolon-separated clauses ``expr op value`` with
+    ``expr`` one of ``rate(counter)`` (per-second rate between
+    evaluations), ``gauge(name)`` (latest value, summed across label
+    sets), ``ratio(a, b)`` (counter ``a`` per counter ``b`` —
+    e.g. DCN bytes per engine round) and ``op`` one of ``< <= > >=``.
+    Example: ``"rate(tpfl_engine_rounds_total) >= 2.0;
+    gauge(tpfl_engine_idle_gap_seconds) <= 0.5"``. Signals are
+    EWMA-smoothed (``SLO_EWMA``); ``SLO_BREACH_WINDOWS`` consecutive
+    violating evaluations emit a ``slo_breach`` flight event and bump
+    ``tpfl_slo_breach_total`` — bench's offline baseline gate brought
+    into running federations. Empty (default) = watchdog idle."""
+
+    SLO_EWMA: float = 0.3
+    """EWMA smoothing factor for SLO watchdog signals (weight of the
+    NEWEST observation; 1.0 = no smoothing). Smoothing keeps a single
+    slow scrape interval or GC pause from counting as a breach window
+    — the watchdog is after sustained regressions, not blips."""
+
+    SLO_BREACH_WINDOWS: int = 2
+    """Consecutive violating evaluations before a breach fires (the
+    ``slo_breach`` flight event + ``tpfl_slo_breach_total`` counter).
+    The streak resets on any healthy evaluation; after firing, the
+    breach re-arms only once the target goes healthy again — a
+    sustained breach is ONE event, not one per evaluation."""
+
     GOSSIP_METRICS: bool = True
     """Broadcast eval metrics to the federation after each round
     (reference MetricsCommand behavior). At N nodes each broadcast
@@ -993,6 +1042,14 @@ class Settings:
         cls.TELEMETRY_MAX_LABELSETS = 64
         cls.TELEMETRY_DUMP_DIR = ""
         cls.METRIC_MAX_POINTS = 4096
+        # Fleet observatory off in tests by default: fleetobs tests
+        # arm the publisher/watchdog per-case with explicit dirs,
+        # targets and (deterministic) evaluation timestamps.
+        cls.FLEETOBS_SNAPSHOT_PERIOD = 0.0
+        cls.FLEETOBS_DIR = ""
+        cls.SLO_TARGETS = ""
+        cls.SLO_EWMA = 0.3
+        cls.SLO_BREACH_WINDOWS = 2
         # Device-plane profiling off by default (profiling tests and
         # the bench profiling tier toggle per-case); a low storm
         # threshold would misfire on tests that legitimately churn
@@ -1135,6 +1192,14 @@ class Settings:
         cls.TELEMETRY_MAX_LABELSETS = 64
         cls.TELEMETRY_DUMP_DIR = ""
         cls.METRIC_MAX_POINTS = 4096
+        # Fleet observatory: an interactive single host IS its own
+        # fleet — no periodic snapshot publisher, no standing SLOs;
+        # point FLEETOBS_DIR/SLO_TARGETS at an experiment explicitly.
+        cls.FLEETOBS_SNAPSHOT_PERIOD = 0.0
+        cls.FLEETOBS_DIR = ""
+        cls.SLO_TARGETS = ""
+        cls.SLO_EWMA = 0.3
+        cls.SLO_BREACH_WINDOWS = 2
         # Profiling is an opt-in diagnostic here, like tracing: enable
         # it (or pass the CLI's --profile) for a run you intend to
         # read attribution/traces from.
@@ -1318,6 +1383,16 @@ class Settings:
         cls.TELEMETRY_MAX_LABELSETS = 64
         cls.TELEMETRY_DUMP_DIR = ""
         cls.METRIC_MAX_POINTS = 4096
+        # Scale is what the fleet plane is FOR, but the publisher
+        # still needs an operator-provided shared dir (a deployment
+        # decision, like CHECKPOINT_DIR): a 30 s cadence costs one
+        # registry fold + one small JSON write per period once armed.
+        # SLOs are per-deployment numbers — no universal default.
+        cls.FLEETOBS_SNAPSHOT_PERIOD = 30.0
+        cls.FLEETOBS_DIR = ""
+        cls.SLO_TARGETS = ""
+        cls.SLO_EWMA = 0.3
+        cls.SLO_BREACH_WINDOWS = 2
         # 1000 in-process nodes: per-call signature probes and round
         # spans share the GIL with the federation — profiling stays an
         # explicit opt-in, and a higher storm threshold tolerates the
